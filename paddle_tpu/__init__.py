@@ -1,0 +1,50 @@
+"""paddle_tpu: a TPU-native deep-learning framework.
+
+A ground-up JAX/XLA/Pallas re-design with the capabilities of the reference
+PaddlePaddle v0.11.0 (see SURVEY.md): Program/Block/Op IR compiled to single
+XLA programs, a layer DSL, 9+ optimizers, ragged (LoD) sequence machinery,
+data-parallel + sharded-embedding training over a jax.sharding.Mesh, and the
+book/benchmark model zoo.
+
+Quick start (fit_a_line, reference book/01)::
+
+    import paddle_tpu as pt
+    x = pt.layers.data("x", shape=[13])
+    y = pt.layers.data("y", shape=[1])
+    pred = pt.layers.fc(x, size=1)
+    loss = pt.layers.mean(pt.layers.square_error_cost(pred, y))
+    pt.optimizer.SGD(learning_rate=0.01).minimize(loss)
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    out, = exe.run(feed={"x": xs, "y": ys}, fetch_list=[loss])
+"""
+
+from . import core  # noqa: F401
+from . import ops  # noqa: F401  (registers all kernels)
+from . import initializer  # noqa: F401
+from . import layers  # noqa: F401
+from . import optimizer  # noqa: F401
+from . import regularizer  # noqa: F401
+from .core import (  # noqa: F401
+    CPUPlace,
+    Executor,
+    LoDArray,
+    Program,
+    Scope,
+    TPUPlace,
+    append_backward,
+    default_main_program,
+    default_startup_program,
+    global_scope,
+    program_guard,
+    reset_default_programs,
+    reset_global_scope,
+)
+from .param_attr import ParamAttr  # noqa: F401
+from .version import full_version as __version__  # noqa: F401
+
+
+def reset():
+    """Fresh default programs + scope (test isolation helper)."""
+    reset_default_programs()
+    reset_global_scope()

@@ -1,0 +1,23 @@
+"""Core: Program IR, Executor, Scope, Place, LoD ragged batches, registry.
+
+Reference seam: paddle/framework/ (ProgramDesc/Scope/LoDTensor/Executor) —
+see SURVEY.md §2.1 "Fluid IR/runtime".
+"""
+
+from .backward import append_backward  # noqa: F401
+from .executor import Executor, Scope, global_scope, reset_global_scope  # noqa: F401
+from .lod import LoDArray  # noqa: F401
+from .place import CPUPlace, Place, TPUPlace, default_place, is_tpu_available  # noqa: F401
+from .program import (  # noqa: F401
+    Block,
+    Operator,
+    Program,
+    Variable,
+    default_main_program,
+    default_startup_program,
+    grad_var_name,
+    program_guard,
+    reset_default_programs,
+    unique_name,
+)
+from .registry import OpContext, get_kernel, register_op, registered_ops  # noqa: F401

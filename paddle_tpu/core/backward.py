@@ -1,0 +1,53 @@
+"""append_backward: mark a loss and materialize parameter gradients.
+
+Reference: python/paddle/v2/fluid/backward.py:338 `append_backward` walks the
+program in reverse appending grad-op descs per forward op
+(_append_backward_ops_ :202, via core.get_grad_op_desc). The TPU rebuild
+replaces that with a single `autodiff` meta-op; the Executor lowers it to
+jax.grad over the traced forward slice (core/executor.py), which XLA
+differentiates and fuses globally. The observable contract is identical:
+after append_backward(loss), each trainable parameter P has a gradient
+variable `P@GRAD` available to optimizer ops.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+from .program import Program, Variable, default_main_program, grad_var_name
+
+
+def append_backward(
+    loss: Variable,
+    parameter_list: Optional[Sequence] = None,
+    no_grad_set: Optional[set] = None,
+) -> List[tuple]:
+    """Returns [(param_var, grad_var)] like the fluid API."""
+    program = loss.block.program
+    block = program.global_block()
+    no_grad = {
+        (v.name if isinstance(v, Variable) else v) for v in (no_grad_set or set())
+    }
+    if parameter_list is not None:
+        params = [
+            block.var(p) if not isinstance(p, Variable) else p
+            for p in parameter_list
+        ]
+    else:
+        params = program.parameters()
+    params = [p for p in params if p.trainable and p.name not in no_grad]
+    if not params:
+        raise ValueError("append_backward: no trainable parameters in program")
+
+    grad_vars = []
+    for p in params:
+        g = block.create_var(grad_var_name(p.name), p.shape, p.dtype)
+        grad_vars.append(g)
+
+    block.append_op(
+        type="autodiff",
+        inputs={"Loss": [loss]},
+        outputs={"Grads": grad_vars},
+        attrs={"params": [p.name for p in params]},
+    )
+    return list(zip(params, grad_vars))

@@ -1,0 +1,177 @@
+"""Ragged (variable-length) batch representation — the LoD equivalent.
+
+Reference: paddle/framework/lod_tensor.h:44-58 stores a `LoD` (level-of-detail)
+vector of offset tables next to a dense tensor; Gen-1 uses
+Argument.sequenceStartPositions / subSequenceStartPositions
+(paddle/parameter/Argument.h:84-90) for the same purpose. Sequences are
+concatenated with NO padding and every sequence op consumes the offset table.
+
+On TPU, XLA wants static shapes, so the rebuild uses *padded-flat* form:
+
+  data     : [capacity, ...]   all tokens of all sequences concatenated, then
+                               padded up to a static bucket `capacity`
+  seq_ids  : [capacity] int32  segment id per token; padding slots = -1
+  lengths  : [max_seqs] int32  per-sequence token counts (0 for absent seqs)
+  num_seqs : scalar int32      actual number of sequences in the batch
+
+This keeps the reference's "no per-sequence padding waste" property (capacity
+buckets amortize recompilation) while every op stays static-shaped: sequence
+ops become segment reductions over `seq_ids`, recurrences convert to
+time-major dense + mask via `to_batch()` (the sequence2batch transform,
+reference: paddle/operators/math/sequence2batch.h).
+
+A second level (sub-sequences, for hierarchical RNN — Argument.h:90) is
+carried as `sub_seq_ids` with the same convention.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _round_up(n: int, multiple: int) -> int:
+    return ((n + multiple - 1) // multiple) * multiple
+
+
+@jax.tree_util.register_pytree_node_class
+class LoDArray:
+    """Ragged batch of sequences in padded-flat form (see module docstring)."""
+
+    def __init__(self, data, seq_ids, lengths, num_seqs, sub_seq_ids=None):
+        self.data = data
+        self.seq_ids = seq_ids
+        self.lengths = lengths
+        self.num_seqs = num_seqs
+        self.sub_seq_ids = sub_seq_ids
+
+    # -- pytree protocol ----------------------------------------------------
+    def tree_flatten(self):
+        return (
+            (self.data, self.seq_ids, self.lengths, self.num_seqs, self.sub_seq_ids),
+            None,
+        )
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+    # -- construction -------------------------------------------------------
+    @staticmethod
+    def from_sequences(
+        seqs: Sequence[np.ndarray],
+        capacity: Optional[int] = None,
+        max_seqs: Optional[int] = None,
+        bucket: int = 128,
+        dtype=None,
+    ) -> "LoDArray":
+        """Build from a list of [len_i, ...] numpy arrays (host side)."""
+        seqs = [np.asarray(s) for s in seqs]
+        total = sum(int(s.shape[0]) for s in seqs)
+        cap = capacity or max(_round_up(max(total, 1), bucket), bucket)
+        if total > cap:
+            raise ValueError(f"total tokens {total} exceed capacity {cap}")
+        nseq_cap = max_seqs or len(seqs)
+        trailing = seqs[0].shape[1:] if seqs else ()
+        dt = dtype or (seqs[0].dtype if seqs else np.float32)
+        data = np.zeros((cap,) + tuple(trailing), dtype=dt)
+        seq_ids = np.full((cap,), -1, dtype=np.int32)
+        lengths = np.zeros((nseq_cap,), dtype=np.int32)
+        off = 0
+        for i, s in enumerate(seqs):
+            n = int(s.shape[0])
+            data[off : off + n] = s
+            seq_ids[off : off + n] = i
+            lengths[i] = n
+            off += n
+        return LoDArray(
+            jnp.asarray(data),
+            jnp.asarray(seq_ids),
+            jnp.asarray(lengths),
+            jnp.asarray(len(seqs), dtype=jnp.int32),
+        )
+
+    # -- properties ----------------------------------------------------------
+    @property
+    def capacity(self) -> int:
+        return self.data.shape[0]
+
+    @property
+    def max_seqs(self) -> int:
+        return self.lengths.shape[0]
+
+    @property
+    def token_mask(self):
+        """[capacity] bool — True on real tokens."""
+        return self.seq_ids >= 0
+
+    @property
+    def offsets(self):
+        """[max_seqs + 1] int32 exclusive-scan of lengths (the reference's
+
+        sequenceStartPositions, Argument.h:84)."""
+        return jnp.concatenate(
+            [jnp.zeros((1,), jnp.int32), jnp.cumsum(self.lengths, dtype=jnp.int32)]
+        )
+
+    # -- layout transforms ---------------------------------------------------
+    def to_batch(self, max_len: Optional[int] = None, time_major: bool = True):
+        """Ragged-flat → dense [T, B, ...] (+ mask [T, B]) for lax.scan RNNs.
+
+        The sequence2batch transform (reference:
+        paddle/operators/math/sequence2batch.h, gserver/layers/SequenceToBatch.cpp)
+        reorders tokens so each timestep is a contiguous batch. Here we emit a
+        dense padded tensor + mask; XLA masks instead of compacting. `max_len`
+        must be static; it defaults to the flat capacity (worst case — pass a
+        bucketed max for efficiency).
+        """
+        if max_len is None:
+            max_len = self.capacity
+        B = self.max_seqs
+        offs = self.offsets[:-1]  # [B]
+        t_idx = jnp.arange(max_len)[None, :]  # [1, T]
+        gather = offs[:, None] + t_idx  # [B, T]
+        valid = t_idx < self.lengths[:, None]  # [B, T]
+        gather = jnp.clip(gather, 0, self.capacity - 1)
+        batched = jnp.where(
+            valid.reshape(valid.shape + (1,) * (self.data.ndim - 1)),
+            self.data[gather],
+            0,
+        )  # [B, T, ...]
+        if time_major:
+            batched = jnp.swapaxes(batched, 0, 1)  # [T, B, ...]
+            valid = valid.T  # [T, B]
+        return batched, valid
+
+    @staticmethod
+    def from_batch(batched, mask, like: "LoDArray") -> "LoDArray":
+        """Inverse of to_batch: dense [T, B, ...] + mask → ragged-flat,
+
+        with the same lod structure as `like`."""
+        if batched.shape[0] != mask.shape[0]:
+            raise ValueError("batched/mask disagree")
+        T, B = mask.shape
+        batched_bm = jnp.swapaxes(batched, 0, 1)  # [B, T, ...]
+        offs = like.offsets[:-1]
+        # scatter token (b, t) -> flat slot offs[b] + t
+        flat_idx = offs[:, None] + jnp.arange(T)[None, :]  # [B, T]
+        flat_idx = jnp.where(mask.T, flat_idx, like.capacity)  # dump padding
+        data = jnp.zeros_like(
+            like.data, shape=(like.capacity + 1,) + batched_bm.shape[2:]
+        ).astype(batched.dtype)
+        data = data.at[flat_idx.reshape(-1)].set(
+            batched_bm.reshape((B * T,) + batched_bm.shape[2:])
+        )[:-1]
+        return LoDArray(data, like.seq_ids, like.lengths, like.num_seqs, like.sub_seq_ids)
+
+    def with_data(self, data) -> "LoDArray":
+        return LoDArray(data, self.seq_ids, self.lengths, self.num_seqs, self.sub_seq_ids)
+
+    def __repr__(self):
+        return (
+            f"LoDArray(data={getattr(self.data, 'shape', None)}, "
+            f"max_seqs={self.max_seqs})"
+        )

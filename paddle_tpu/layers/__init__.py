@@ -1,0 +1,9 @@
+"""Layer DSL (reference: fluid `layers` package + Gen-1
+
+trainer_config_helpers). Import side effect: registers nothing — pure
+front-end over core.program + ops."""
+
+from .nn import *  # noqa: F401,F403
+from .nn import __all__ as _nn_all
+
+__all__ = list(_nn_all)

@@ -1,0 +1,514 @@
+"""Layer DSL: functions that append ops to the default Program.
+
+Reference: python/paddle/v2/fluid/layers/nn.py (fc :63, embedding :184,
+conv2d :772, …) and the Gen-1 DSL python/paddle/trainer_config_helpers/
+layers.py (fc_layer, img_conv_layer, …). Each function builds params via
+LayerHelper and appends ops; shapes use -1 for the batch dim.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+from ..core.program import Variable, default_main_program
+from ..initializer import ConstantInitializer, NormalInitializer
+from .helper import LayerHelper
+
+__all__ = [
+    "data",
+    "fc",
+    "embedding",
+    "conv2d",
+    "conv2d_transpose",
+    "pool2d",
+    "batch_norm",
+    "layer_norm",
+    "dropout",
+    "cross_entropy",
+    "softmax_with_cross_entropy",
+    "square_error_cost",
+    "accuracy",
+    "mean",
+    "concat",
+    "reshape",
+    "transpose",
+    "softmax",
+    "relu",
+    "sigmoid",
+    "tanh",
+    "elementwise_add",
+    "elementwise_sub",
+    "elementwise_mul",
+    "elementwise_div",
+    "scale",
+    "cast",
+    "topk",
+    "argmax",
+    "lrn",
+    "matmul",
+    "reduce_sum",
+    "reduce_mean",
+    "split",
+    "expand",
+]
+
+
+def data(
+    name: str,
+    shape: Sequence[int],
+    dtype=np.float32,
+    lod_level: int = 0,
+    append_batch_size: bool = True,
+) -> Variable:
+    """Reference: fluid layers/io.py `data` — declares a feed variable.
+
+    shape excludes the batch dim when append_batch_size=True."""
+    block = default_main_program().current_block()
+    full_shape = ((-1,) + tuple(shape)) if append_batch_size else tuple(shape)
+    return block.create_var(name, full_shape, dtype, lod_level=lod_level)
+
+
+def fc(
+    input,
+    size: int,
+    act: Optional[str] = None,
+    num_flatten_dims: int = 1,
+    param_attr=None,
+    bias_attr=None,
+    name=None,
+) -> Variable:
+    """Reference: fluid layers/nn.py:63 `fc`; Gen-1 fc_layer
+
+    (trainer_config_helpers/layers.py) / FullyConnectedLayer.cpp:27.
+    Multiple inputs are summed after their own W (MixedLayer semantics)."""
+    helper = LayerHelper("fc", name=name)
+    inputs = input if isinstance(input, (list, tuple)) else [input]
+    mul_outs = []
+    for i, inp in enumerate(inputs):
+        in_dim = int(np.prod(inp.shape[num_flatten_dims:]))
+        w = helper.create_parameter(
+            param_attr if not isinstance(param_attr, (list, tuple)) else param_attr[i],
+            shape=(in_dim, size),
+            dtype=inp.dtype,
+        )
+        out = helper.create_tmp_variable(inp.dtype, inp.shape[:num_flatten_dims] + (size,), inp.lod_level)
+        helper.append_op(
+            type="mul",
+            inputs={"X": [inp], "Y": [w]},
+            outputs={"Out": [out]},
+            attrs={"x_num_col_dims": num_flatten_dims, "y_num_col_dims": 1},
+        )
+        mul_outs.append(out)
+    if len(mul_outs) == 1:
+        pre_bias = mul_outs[0]
+    else:
+        pre_bias = helper.create_tmp_variable(inputs[0].dtype, mul_outs[0].shape)
+        helper.append_op(type="sum", inputs={"X": mul_outs}, outputs={"Out": [pre_bias]})
+    if bias_attr is not False:
+        b = helper.create_parameter(bias_attr, shape=(size,), is_bias=True)
+        pre_act = helper.create_tmp_variable(pre_bias.dtype, pre_bias.shape, pre_bias.lod_level)
+        helper.append_op(
+            type="elementwise_add",
+            inputs={"X": [pre_bias], "Y": [b]},
+            outputs={"Out": [pre_act]},
+            attrs={"axis": -1},
+        )
+    else:
+        pre_act = pre_bias
+    return helper.append_activation(pre_act, act)
+
+
+def embedding(
+    input,
+    size: Sequence[int],
+    is_sparse: bool = False,
+    padding_idx: Optional[int] = None,
+    param_attr=None,
+    dtype=np.float32,
+    name=None,
+) -> Variable:
+    """Reference: fluid layers/nn.py:184 `embedding` / lookup_table_op.cc.
+
+    is_sparse is accepted for API parity; XLA lowers the gather grad to
+    scatter-add which is the same thing SelectedRows bought the reference."""
+    helper = LayerHelper("embedding", name=name)
+    w = helper.create_parameter(
+        param_attr,
+        shape=tuple(size),
+        dtype=dtype,
+        default_initializer=NormalInitializer(0.0, 0.01),
+    )
+    out = helper.create_tmp_variable(dtype, input.shape + (size[1],), input.lod_level)
+    helper.append_op(
+        type="lookup_table",
+        inputs={"W": [w], "Ids": [input]},
+        outputs={"Out": [out]},
+        attrs={"is_sparse": is_sparse, "padding_idx": padding_idx},
+    )
+    return out
+
+
+def conv2d(
+    input,
+    num_filters: int,
+    filter_size,
+    stride=1,
+    padding=0,
+    dilation=1,
+    groups: int = 1,
+    act: Optional[str] = None,
+    param_attr=None,
+    bias_attr=None,
+    name=None,
+) -> Variable:
+    """Reference: fluid layers/nn.py:772 `conv2d`; Gen-1 img_conv_layer."""
+    helper = LayerHelper("conv2d", name=name)
+    in_c = input.shape[1]
+    fh, fw = (filter_size, filter_size) if isinstance(filter_size, int) else filter_size
+    w_shape = (num_filters, in_c // groups, fh, fw)
+    fan_in = (in_c // groups) * fh * fw
+    std = (2.0 / fan_in) ** 0.5
+    w = helper.create_parameter(
+        param_attr, w_shape, default_initializer=NormalInitializer(0.0, std)
+    )
+    inputs = {"Input": [input], "Filter": [w]}
+    if bias_attr is not False:
+        b = helper.create_parameter(bias_attr, (num_filters,), is_bias=True)
+        inputs["Bias"] = [b]
+    out = helper.create_tmp_variable(input.dtype, (-1, num_filters, -1, -1))
+    helper.append_op(
+        type="conv2d",
+        inputs=inputs,
+        outputs={"Output": [out]},
+        attrs={
+            "strides": stride,
+            "paddings": padding,
+            "dilations": dilation,
+            "groups": groups,
+        },
+    )
+    return helper.append_activation(out, act)
+
+
+def conv2d_transpose(
+    input, num_filters, filter_size, stride=1, padding=0, param_attr=None, name=None
+) -> Variable:
+    helper = LayerHelper("conv2d_transpose", name=name)
+    in_c = input.shape[1]
+    fh, fw = (filter_size, filter_size) if isinstance(filter_size, int) else filter_size
+    w = helper.create_parameter(param_attr, (in_c, num_filters, fh, fw))
+    out = helper.create_tmp_variable(input.dtype, (-1, num_filters, -1, -1))
+    helper.append_op(
+        type="conv2d_transpose",
+        inputs={"Input": [input], "Filter": [w]},
+        outputs={"Output": [out]},
+        attrs={"strides": stride, "paddings": padding},
+    )
+    return out
+
+
+def pool2d(
+    input,
+    pool_size=2,
+    pool_type: str = "max",
+    pool_stride=None,
+    pool_padding=0,
+    global_pooling: bool = False,
+    exclusive: bool = True,
+    name=None,
+) -> Variable:
+    """Reference: fluid layers/nn.py `pool2d` / pool_op.cc."""
+    helper = LayerHelper("pool2d", name=name)
+    out = helper.create_tmp_variable(input.dtype, (-1, input.shape[1], -1, -1))
+    helper.append_op(
+        type="pool2d",
+        inputs={"X": [input]},
+        outputs={"Out": [out]},
+        attrs={
+            "pooling_type": pool_type,
+            "ksize": pool_size,
+            "strides": pool_stride if pool_stride is not None else pool_size,
+            "paddings": pool_padding,
+            "global_pooling": global_pooling,
+            "exclusive": exclusive,
+        },
+    )
+    return out
+
+
+def batch_norm(
+    input,
+    act: Optional[str] = None,
+    momentum: float = 0.9,
+    epsilon: float = 1e-5,
+    is_test: bool = False,
+    param_attr=None,
+    bias_attr=None,
+    name=None,
+) -> Variable:
+    """Reference: fluid layers/nn.py `batch_norm` / batch_norm_op.cc."""
+    helper = LayerHelper("batch_norm", name=name)
+    c = input.shape[1]
+    scale = helper.create_parameter(
+        param_attr, (c,), default_initializer=ConstantInitializer(1.0)
+    )
+    bias = helper.create_parameter(bias_attr, (c,), is_bias=True)
+    mean = helper.create_parameter(
+        None, (c,), default_initializer=ConstantInitializer(0.0)
+    )
+    var = helper.create_parameter(
+        None, (c,), default_initializer=ConstantInitializer(1.0)
+    )
+    # running stats are state, not trainable weights
+    mean.trainable = False
+    mean.is_parameter = False
+    mean.persistable = True
+    var.trainable = False
+    var.is_parameter = False
+    var.persistable = True
+    out = helper.create_tmp_variable(input.dtype, input.shape)
+    helper.append_op(
+        type="batch_norm",
+        inputs={"X": [input], "Scale": [scale], "Bias": [bias],
+                "Mean": [mean], "Variance": [var]},
+        outputs={"Y": [out]},
+        attrs={"momentum": momentum, "epsilon": epsilon, "is_test": is_test},
+    )
+    return helper.append_activation(out, act)
+
+
+def layer_norm(input, scale=True, shift=True, begin_norm_axis=1, epsilon=1e-5, name=None):
+    helper = LayerHelper("layer_norm", name=name)
+    dim = int(np.prod(input.shape[begin_norm_axis:]))
+    inputs = {"X": [input]}
+    if scale:
+        inputs["Scale"] = [
+            helper.create_parameter(None, (dim,), default_initializer=ConstantInitializer(1.0))
+        ]
+    if shift:
+        inputs["Bias"] = [helper.create_parameter(None, (dim,), is_bias=True)]
+    out = helper.create_tmp_variable(input.dtype, input.shape)
+    helper.append_op(
+        type="layer_norm",
+        inputs=inputs,
+        outputs={"Y": [out]},
+        attrs={"begin_norm_axis": begin_norm_axis, "epsilon": epsilon},
+    )
+    return out
+
+
+def dropout(x, dropout_prob: float, is_test: bool = False, name=None) -> Variable:
+    helper = LayerHelper("dropout", name=name)
+    out = helper.create_tmp_variable(x.dtype, x.shape, x.lod_level)
+    helper.append_op(
+        type="dropout",
+        inputs={"X": [x]},
+        outputs={"Out": [out]},
+        attrs={"dropout_prob": dropout_prob, "is_test": is_test},
+    )
+    return out
+
+
+# ------------------------------------------------------------- losses ------
+def cross_entropy(input, label, soft_label: bool = False) -> Variable:
+    helper = LayerHelper("cross_entropy")
+    out = helper.create_tmp_variable(input.dtype, (input.shape[0], 1))
+    helper.append_op(
+        type="cross_entropy",
+        inputs={"X": [input], "Label": [label]},
+        outputs={"Y": [out]},
+        attrs={"soft_label": soft_label},
+    )
+    return out
+
+
+def softmax_with_cross_entropy(logits, label, soft_label: bool = False):
+    helper = LayerHelper("softmax_with_cross_entropy")
+    softmax_out = helper.create_tmp_variable(logits.dtype, logits.shape)
+    loss = helper.create_tmp_variable(logits.dtype, (logits.shape[0], 1))
+    helper.append_op(
+        type="softmax_with_cross_entropy",
+        inputs={"Logits": [logits], "Label": [label]},
+        outputs={"Softmax": [softmax_out], "Loss": [loss]},
+        attrs={"soft_label": soft_label},
+    )
+    return loss
+
+
+def square_error_cost(input, label) -> Variable:
+    helper = LayerHelper("square_error_cost")
+    out = helper.create_tmp_variable(input.dtype, input.shape)
+    helper.append_op(
+        type="square_error_cost",
+        inputs={"X": [input], "Y": [label]},
+        outputs={"Out": [out]},
+    )
+    return out
+
+
+def accuracy(input, label, k: int = 1) -> Variable:
+    """Reference: fluid layers accuracy — topk + accuracy op."""
+    helper = LayerHelper("accuracy")
+    vals = helper.create_tmp_variable(input.dtype, input.shape[:-1] + (k,))
+    idxs = helper.create_tmp_variable(np.int64, input.shape[:-1] + (k,))
+    helper.append_op(
+        type="top_k",
+        inputs={"X": [input]},
+        outputs={"Out": [vals], "Indices": [idxs]},
+        attrs={"k": k},
+    )
+    acc = helper.create_tmp_variable(np.float32, ())
+    helper.append_op(
+        type="accuracy",
+        inputs={"Indices": [idxs], "Label": [label]},
+        outputs={"Accuracy": [acc]},
+    )
+    return acc
+
+
+# ------------------------------------------------- elementwise / shape ------
+def _unary(op_type, x, attrs=None, out_shape=None):
+    helper = LayerHelper(op_type)
+    out = helper.create_tmp_variable(x.dtype, out_shape if out_shape is not None else x.shape, x.lod_level)
+    helper.append_op(type=op_type, inputs={"X": [x]}, outputs={"Out": [out]}, attrs=attrs or {})
+    return out
+
+
+def _binary(op_type, x, y, attrs=None):
+    helper = LayerHelper(op_type)
+    out = helper.create_tmp_variable(x.dtype, x.shape, x.lod_level)
+    helper.append_op(
+        type=op_type, inputs={"X": [x], "Y": [y]}, outputs={"Out": [out]}, attrs=attrs or {}
+    )
+    return out
+
+
+def mean(x):
+    return _unary("mean", x, out_shape=())
+
+
+def softmax(x):
+    return _unary("softmax", x)
+
+
+def relu(x):
+    return _unary("relu", x)
+
+
+def sigmoid(x):
+    return _unary("sigmoid", x)
+
+
+def tanh(x):
+    return _unary("tanh", x)
+
+
+def elementwise_add(x, y, axis=-1):
+    return _binary("elementwise_add", x, y, {"axis": axis})
+
+
+def elementwise_sub(x, y, axis=-1):
+    return _binary("elementwise_sub", x, y, {"axis": axis})
+
+
+def elementwise_mul(x, y, axis=-1):
+    return _binary("elementwise_mul", x, y, {"axis": axis})
+
+
+def elementwise_div(x, y, axis=-1):
+    return _binary("elementwise_div", x, y, {"axis": axis})
+
+
+def scale(x, scale=1.0, bias=0.0):
+    return _unary("scale", x, {"scale": scale, "bias": bias})
+
+
+def cast(x, dtype):
+    helper = LayerHelper("cast")
+    out = helper.create_tmp_variable(np.dtype(dtype), x.shape, x.lod_level)
+    helper.append_op(
+        type="cast", inputs={"X": [x]}, outputs={"Out": [out]},
+        attrs={"dtype": np.dtype(dtype).name},
+    )
+    return out
+
+
+def concat(input, axis=0):
+    helper = LayerHelper("concat")
+    out = helper.create_tmp_variable(input[0].dtype, input[0].shape)
+    helper.append_op(
+        type="concat", inputs={"X": list(input)}, outputs={"Out": [out]},
+        attrs={"axis": axis},
+    )
+    return out
+
+
+def reshape(x, shape):
+    return _unary("reshape", x, {"shape": list(shape)}, out_shape=tuple(shape))
+
+
+def transpose(x, perm):
+    return _unary("transpose", x, {"axis": list(perm)},
+                  out_shape=tuple(x.shape[i] for i in perm))
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False):
+    return _binary("matmul", x, y, {"transpose_X": transpose_x, "transpose_Y": transpose_y})
+
+
+def reduce_sum(x, dim=None, keep_dim=False):
+    return _unary(
+        "reduce_sum", x,
+        {"dim": dim, "keep_dim": keep_dim, "reduce_all": dim is None},
+    )
+
+
+def reduce_mean(x, dim=None, keep_dim=False):
+    return _unary(
+        "reduce_mean", x,
+        {"dim": dim, "keep_dim": keep_dim, "reduce_all": dim is None},
+    )
+
+
+def split(x, num_or_sections, dim=0):
+    helper = LayerHelper("split")
+    if isinstance(num_or_sections, int):
+        n = num_or_sections
+        attrs = {"num": n, "axis": dim}
+    else:
+        n = len(num_or_sections)
+        attrs = {"sections": list(num_or_sections), "axis": dim}
+    outs = [helper.create_tmp_variable(x.dtype, x.shape) for _ in range(n)]
+    helper.append_op(type="split", inputs={"X": [x]}, outputs={"Out": outs}, attrs=attrs)
+    return outs
+
+
+def expand(x, expand_times):
+    return _unary("expand", x, {"expand_times": list(expand_times)})
+
+
+def topk(input, k=1):
+    helper = LayerHelper("top_k")
+    vals = helper.create_tmp_variable(input.dtype, input.shape[:-1] + (k,))
+    idxs = helper.create_tmp_variable(np.int64, input.shape[:-1] + (k,))
+    helper.append_op(
+        type="top_k", inputs={"X": [input]},
+        outputs={"Out": [vals], "Indices": [idxs]}, attrs={"k": k},
+    )
+    return vals, idxs
+
+
+def argmax(x, axis=-1):
+    helper = LayerHelper("argmax")
+    out = helper.create_tmp_variable(np.int64, x.shape[:-1])
+    helper.append_op(
+        type="argmax", inputs={"X": [x]}, outputs={"Out": [out]},
+        attrs={"axis": axis},
+    )
+    return out
+
+
+def lrn(input, n=5, k=2.0, alpha=1e-4, beta=0.75):
+    return _unary("lrn", input, {"n": n, "k": k, "alpha": alpha, "beta": beta})

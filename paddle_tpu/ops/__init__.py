@@ -1,0 +1,14 @@
+"""Op kernel library. Importing this package registers all kernels.
+
+Reference: paddle/operators/ — 191 op families registered via REGISTER_OP
+(framework/op_registry.h:148). Here each submodule registers pure-JAX
+kernels with core.registry; gradients are derived by jax.grad over the
+traced program instead of hand-written grad kernels.
+"""
+
+from . import activation_ops  # noqa: F401
+from . import math_ops  # noqa: F401
+from . import nn_ops  # noqa: F401
+from . import optimizer_ops  # noqa: F401
+from . import sequence_ops  # noqa: F401
+from ..core.registry import registered_ops  # noqa: F401

@@ -1,0 +1,114 @@
+"""Activation op kernels.
+
+Reference: paddle/gserver/activations/ActivationFunction.cpp (15 Gen-1
+activation types via BEGIN_DEFINE_ACTIVATION) and
+paddle/operators/activation_op.cc (28 Fluid activation ops: sigmoid,
+logsigmoid, exp, relu, tanh, tanh_shrink, softshrink, sqrt, abs, ceil,
+floor, round, reciprocal, log, square, softplus, softsign, brelu,
+leaky_relu, soft_relu, elu, relu6, pow, stanh, hard_shrink,
+thresholded_relu, hard_sigmoid, swish). All map to jnp/jax.nn primitives;
+XLA fuses them into neighbouring matmuls so no custom kernels are needed —
+this is exactly the elementwise-fusion case the MXU pipeline handles free.
+
+Gradients come from jax.grad (core/executor.py); no backward kernels.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.lod import LoDArray
+from ..core.registry import register_op
+
+# name -> fn(x, attr) ; attrs carry the reference's default thresholds
+_ACTIVATIONS = {
+    "identity": lambda x, a: x,
+    "linear": lambda x, a: x,
+    "sigmoid": lambda x, a: jax.nn.sigmoid(x),
+    "logsigmoid": lambda x, a: jax.nn.log_sigmoid(x),
+    "exp": lambda x, a: jnp.exp(x),
+    "exponential": lambda x, a: jnp.exp(x),
+    "relu": lambda x, a: jax.nn.relu(x),
+    "tanh": lambda x, a: jnp.tanh(x),
+    "tanh_shrink": lambda x, a: x - jnp.tanh(x),
+    "softshrink": lambda x, a: jnp.sign(x)
+    * jnp.maximum(jnp.abs(x) - a.get("lambda", 0.5), 0.0),
+    "sqrt": lambda x, a: jnp.sqrt(x),
+    "abs": lambda x, a: jnp.abs(x),
+    "ceil": lambda x, a: jnp.ceil(x),
+    "floor": lambda x, a: jnp.floor(x),
+    "round": lambda x, a: jnp.round(x),
+    "reciprocal": lambda x, a: 1.0 / x,
+    "log": lambda x, a: jnp.log(x),
+    "square": lambda x, a: jnp.square(x),
+    "softplus": lambda x, a: jax.nn.softplus(x),
+    "softsign": lambda x, a: jax.nn.soft_sign(x),
+    # brelu: clipped relu, reference default t_min=0, t_max=24
+    "brelu": lambda x, a: jnp.clip(x, a.get("t_min", 0.0), a.get("t_max", 24.0)),
+    "leaky_relu": lambda x, a: jax.nn.leaky_relu(x, a.get("alpha", 0.02)),
+    # soft_relu: ln(1+e^clip(x)) with threshold 40 (activation_op.cc SoftRelu)
+    "soft_relu": lambda x, a: jnp.log1p(
+        jnp.exp(jnp.clip(x, -a.get("threshold", 40.0), a.get("threshold", 40.0)))
+    ),
+    "softrelu": lambda x, a: jnp.log1p(
+        jnp.exp(jnp.clip(x, -40.0, 40.0))
+    ),
+    "elu": lambda x, a: jax.nn.elu(x, a.get("alpha", 1.0)),
+    "relu6": lambda x, a: jnp.clip(x, 0.0, a.get("threshold", 6.0)),
+    "pow": lambda x, a: jnp.power(x, a.get("factor", 1.0)),
+    # stanh: a*tanh(b*x), reference defaults a=1.7159, b=2/3
+    "stanh": lambda x, a: a.get("scale_a", 1.7159)
+    * jnp.tanh(a.get("scale_b", 2.0 / 3.0) * x),
+    "hard_shrink": lambda x, a: jnp.where(
+        jnp.abs(x) > a.get("threshold", 0.5), x, 0.0
+    ),
+    "thresholded_relu": lambda x, a: jnp.where(
+        x > a.get("threshold", 1.0), x, 0.0
+    ),
+    "hard_sigmoid": lambda x, a: jnp.clip(
+        a.get("slope", 0.2) * x + a.get("offset", 0.5), 0.0, 1.0
+    ),
+    "swish": lambda x, a: x * jax.nn.sigmoid(a.get("beta", 1.0) * x),
+}
+
+
+def apply_activation(x, act: str, attrs=None):
+    """Apply a named activation to an array or LoDArray."""
+    if act is None:
+        return x
+    attrs = attrs or {}
+    if act == "softmax":
+        fn = lambda v: jax.nn.softmax(v, axis=-1)
+    elif act == "sequence_softmax":
+        from .sequence_ops import sequence_softmax_impl
+
+        return sequence_softmax_impl(x)
+    else:
+        try:
+            fn = lambda v, _f=_ACTIVATIONS[act]: _f(v, attrs)
+        except KeyError:
+            raise NotImplementedError(f"unknown activation {act!r}") from None
+    if isinstance(x, LoDArray):
+        return x.with_data(fn(x.data))
+    return fn(x)
+
+
+def _make_kernel(name):
+    def kernel(ctx):
+        x = ctx.input("X")
+        ctx.set_output("Out", apply_activation(x, name, ctx.op.attrs))
+
+    return kernel
+
+
+for _name in list(_ACTIVATIONS) + ["softmax_activation"]:
+    register_op(_name if _name != "softmax_activation" else "softmax_activation")(
+        _make_kernel(_name if _name != "softmax_activation" else "softmax")
+    )
+
+
+@register_op("softmax")
+def softmax_kernel(ctx):
+    """Reference: paddle/operators/softmax_op.cc — softmax over last dim."""
+    ctx.set_output("Out", apply_activation(ctx.input("X"), "softmax"))

@@ -1,0 +1,322 @@
+"""Math / tensor-manipulation op kernels.
+
+Reference coverage: paddle/operators/{mul_op,matmul_op,elementwise_*_op,
+scale_op,sum_op,mean_op,reduce_op,reshape_op,transpose_op,concat_op,
+split_op,clip_op,cast_op,top_k_op,fill_constant_op,uniform_random_op,
+gaussian_random_op,lookup_table_op,squared_l2_norm_op,...}.cc and the
+paddle/math Matrix::mul / BaseMatrix template kernels they sit on. All are
+direct jnp/lax calls — matmuls land on the MXU, elementwise on the VPU,
+everything fuses under the whole-program jit.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.lod import LoDArray
+from ..core.registry import register_op
+
+
+def _data(x):
+    return x.data if isinstance(x, LoDArray) else x
+
+
+def _like(x, data):
+    return x.with_data(data) if isinstance(x, LoDArray) else data
+
+
+# ---------------------------------------------------------------- matmul ---
+@register_op("mul")
+def mul_kernel(ctx):
+    """Reference: paddle/operators/mul_op.cc — flattens X to 2-D by
+
+    x_num_col_dims then GEMM (math/math_function matmul → cuBLAS; here MXU).
+    """
+    x, y = _data(ctx.input("X")), _data(ctx.input("Y"))
+    xd = ctx.attr("x_num_col_dims", 1)
+    yd = ctx.attr("y_num_col_dims", 1)
+    xs, ys = x.shape, y.shape
+    x2 = x.reshape((int(np.prod(xs[:xd])), -1)) if x.ndim > 2 or xd != 1 else x
+    y2 = y.reshape((int(np.prod(ys[:yd])), -1)) if y.ndim > 2 or yd != 1 else y
+    out = jnp.dot(x2, y2, preferred_element_type=jnp.float32)
+    # restore leading dims: out shape is xs[:xd] + ys[yd:] (mul_op.cc InferShape)
+    out_shape = tuple(xs[:xd]) + tuple(ys[yd:])
+    if out.shape != out_shape:
+        out = out.reshape(out_shape)
+    ctx.set_output("Out", out.astype(x.dtype))
+
+
+@register_op("matmul")
+def matmul_kernel(ctx):
+    """Reference: paddle/operators/matmul_op.cc — batched matmul with
+
+    transpose flags."""
+    x, y = _data(ctx.input("X")), _data(ctx.input("Y"))
+    if ctx.attr("transpose_X", False):
+        x = jnp.swapaxes(x, -1, -2)
+    if ctx.attr("transpose_Y", False):
+        y = jnp.swapaxes(y, -1, -2)
+    out = jnp.matmul(x, y, preferred_element_type=jnp.float32).astype(x.dtype)
+    ctx.set_output("Out", out)
+
+
+# ----------------------------------------------------------- elementwise ---
+def _broadcast_y(x, y, axis):
+    """Reference elementwise broadcast rule (elementwise_op_function.h):
+
+    y's shape must match a contiguous slice of x's starting at `axis`."""
+    if y.ndim == x.ndim:
+        return y
+    if axis is None or axis == -1:
+        axis = x.ndim - y.ndim
+    new_shape = (1,) * axis + y.shape + (1,) * (x.ndim - axis - y.ndim)
+    return y.reshape(new_shape)
+
+
+def _make_elementwise(name, fn):
+    def kernel(ctx):
+        x, y = ctx.input("X"), ctx.input("Y")
+        xd, yd = _data(x), _data(y)
+        yd = _broadcast_y(xd, yd, ctx.attr("axis", -1))
+        ctx.set_output("Out", _like(x, fn(xd, yd)))
+
+    register_op(name)(kernel)
+
+
+_make_elementwise("elementwise_add", jnp.add)
+_make_elementwise("elementwise_sub", jnp.subtract)
+_make_elementwise("elementwise_mul", jnp.multiply)
+_make_elementwise("elementwise_div", jnp.divide)
+_make_elementwise("elementwise_max", jnp.maximum)
+_make_elementwise("elementwise_min", jnp.minimum)
+_make_elementwise("elementwise_pow", jnp.power)
+
+
+# ------------------------------------------------------------- reductions --
+@register_op("mean")
+def mean_kernel(ctx):
+    ctx.set_output("Out", jnp.mean(_data(ctx.input("X"))))
+
+
+@register_op("sum")
+def sum_kernel(ctx):
+    """Reference: paddle/operators/sum_op.cc — adds N input tensors."""
+    xs = ctx.inputs("X")
+    out = functools.reduce(jnp.add, [_data(x) for x in xs])
+    ctx.set_output("Out", _like(xs[0], out))
+
+
+def _make_reduce(name, fn):
+    def kernel(ctx):
+        x = _data(ctx.input("X"))
+        dim = ctx.attr("dim", 0)
+        keep = ctx.attr("keep_dim", False)
+        if ctx.attr("reduce_all", False):
+            dim = None
+        ctx.set_output("Out", fn(x, axis=dim, keepdims=keep))
+
+    register_op(name)(kernel)
+
+
+_make_reduce("reduce_sum", jnp.sum)
+_make_reduce("reduce_mean", jnp.mean)
+_make_reduce("reduce_max", jnp.max)
+_make_reduce("reduce_min", jnp.min)
+
+
+# ----------------------------------------------------------- shape manip ---
+@register_op("reshape")
+def reshape_kernel(ctx):
+    x = _data(ctx.input("X"))
+    shape = list(ctx.attr("shape"))
+    ctx.set_output("Out", x.reshape(shape))
+
+
+@register_op("transpose")
+def transpose_kernel(ctx):
+    x = _data(ctx.input("X"))
+    ctx.set_output("Out", jnp.transpose(x, ctx.attr("axis")))
+
+
+@register_op("concat")
+def concat_kernel(ctx):
+    xs = [_data(x) for x in ctx.inputs("X")]
+    ctx.set_output("Out", jnp.concatenate(xs, axis=ctx.attr("axis", 0)))
+
+
+@register_op("split")
+def split_kernel(ctx):
+    x = _data(ctx.input("X"))
+    axis = ctx.attr("axis", 0)
+    num = ctx.attr("num", 0)
+    sections = ctx.attr("sections")
+    if sections:
+        idx = np.cumsum(sections)[:-1].tolist()
+        parts = jnp.split(x, idx, axis=axis)
+    else:
+        parts = jnp.split(x, num, axis=axis)
+    for i, p in enumerate(parts):
+        ctx.set_output("Out", p, idx=i)
+
+
+@register_op("expand")
+def expand_kernel(ctx):
+    x = _data(ctx.input("X"))
+    ctx.set_output("Out", jnp.tile(x, ctx.attr("expand_times")))
+
+
+@register_op("slice")
+def slice_kernel(ctx):
+    x = _data(ctx.input("X"))
+    axes = ctx.attr("axes")
+    starts, ends = ctx.attr("starts"), ctx.attr("ends")
+    idx = [slice(None)] * x.ndim
+    for ax, s, e in zip(axes, starts, ends):
+        idx[ax] = slice(s, e)
+    ctx.set_output("Out", x[tuple(idx)])
+
+
+# ----------------------------------------------------------------- misc ----
+@register_op("scale")
+def scale_kernel(ctx):
+    x = ctx.input("X")
+    s = ctx.attr("scale", 1.0)
+    b = ctx.attr("bias", 0.0)
+    ctx.set_output("Out", _like(x, _data(x) * s + b))
+
+
+@register_op("clip")
+def clip_kernel(ctx):
+    x = ctx.input("X")
+    ctx.set_output(
+        "Out", _like(x, jnp.clip(_data(x), ctx.attr("min"), ctx.attr("max")))
+    )
+
+
+@register_op("cast")
+def cast_kernel(ctx):
+    x = ctx.input("X")
+    ctx.set_output("Out", _like(x, _data(x).astype(np.dtype(ctx.attr("dtype")))))
+
+
+@register_op("sign")
+def sign_kernel(ctx):
+    x = ctx.input("X")
+    ctx.set_output("Out", _like(x, jnp.sign(_data(x))))
+
+
+@register_op("clip_by_norm")
+def clip_by_norm_kernel(ctx):
+    """Reference: paddle/operators/clip_by_norm_op.cc."""
+    x = _data(ctx.input("X"))
+    max_norm = ctx.attr("max_norm")
+    norm = jnp.sqrt(jnp.sum(jnp.square(x)))
+    scale = jnp.minimum(max_norm / jnp.maximum(norm, 1e-12), 1.0)
+    ctx.set_output("Out", x * scale)
+
+
+@register_op("clip_by_global_norm")
+def clip_by_global_norm_kernel(ctx):
+    """Variadic: clips all X[i] by their joint L2 norm (reference semantics:
+
+    fluid clip.py GradientClipByGlobalNorm)."""
+    xs = [_data(x) for x in ctx.inputs("X")]
+    max_norm = ctx.attr("max_global_norm")
+    gnorm = jnp.sqrt(sum(jnp.sum(jnp.square(x)) for x in xs))
+    scale = jnp.minimum(max_norm / jnp.maximum(gnorm, 1e-12), 1.0)
+    for i, x in enumerate(xs):
+        ctx.set_output("Out", x * scale, idx=i)
+
+
+@register_op("squared_l2_norm")
+def squared_l2_norm_kernel(ctx):
+    x = _data(ctx.input("X"))
+    ctx.set_output("Out", jnp.sum(jnp.square(x)))
+
+
+@register_op("top_k")
+def top_k_kernel(ctx):
+    """Reference: paddle/operators/top_k_op.cc, cuda/src/hl_top_k.cu."""
+    x = _data(ctx.input("X"))
+    k = ctx.attr("k", 1)
+    vals, idxs = jax.lax.top_k(x, k)
+    ctx.set_output("Out", vals)
+    ctx.set_output("Indices", idxs.astype(jnp.int64))
+
+
+@register_op("lookup_table")
+def lookup_table_kernel(ctx):
+    """Reference: paddle/operators/lookup_table_op.cc — embedding gather.
+
+    Sparse SelectedRows grads (is_sparse=True) are unnecessary here: jax
+    computes dense grads but XLA lowers gather-grad to scatter-add, and the
+    sharded path lives in parallel/sharded_embedding.py."""
+    w = ctx.input("W")
+    ids = ctx.input("Ids")
+    ids_data = _data(ids)
+    if ids_data.ndim > 1 and ids_data.shape[-1] == 1:
+        ids_data = ids_data[..., 0]
+    out = jnp.take(w, ids_data, axis=0)
+    if ctx.attr("padding_idx") is not None:
+        pad = ctx.attr("padding_idx")
+        out = jnp.where((ids_data == pad)[..., None], 0.0, out)
+    ctx.set_output("Out", _like(ids, out))
+
+
+@register_op("fill_constant")
+def fill_constant_kernel(ctx):
+    shape = ctx.attr("shape")
+    value = ctx.attr("value", 0.0)
+    dtype = np.dtype(ctx.attr("dtype", "float32"))
+    ctx.set_output("Out", jnp.full(shape, value, dtype=dtype))
+
+
+@register_op("assign")
+def assign_kernel(ctx):
+    ctx.set_output("Out", ctx.input("X"))
+
+
+@register_op("increment")
+def increment_kernel(ctx):
+    x = _data(ctx.input("X"))
+    ctx.set_output("Out", x + ctx.attr("step", 1.0))
+
+
+@register_op("argmax")
+def argmax_kernel(ctx):
+    x = _data(ctx.input("X"))
+    ctx.set_output("Out", jnp.argmax(x, axis=ctx.attr("axis", -1)).astype(jnp.int64))
+
+
+# ------------------------------------------------------------ initializers -
+@register_op("uniform_random")
+def uniform_random_kernel(ctx):
+    """Reference: paddle/operators/uniform_random_op.cc."""
+    shape = ctx.attr("shape")
+    lo, hi = ctx.attr("min", -1.0), ctx.attr("max", 1.0)
+    out = jax.random.uniform(
+        ctx.rng(), shape, minval=lo, maxval=hi, dtype=jnp.float32
+    )
+    ctx.set_output("Out", out.astype(np.dtype(ctx.attr("dtype", "float32"))))
+
+
+@register_op("gaussian_random")
+def gaussian_random_kernel(ctx):
+    shape = ctx.attr("shape")
+    mean, std = ctx.attr("mean", 0.0), ctx.attr("std", 1.0)
+    out = mean + std * jax.random.normal(ctx.rng(), shape, dtype=jnp.float32)
+    ctx.set_output("Out", out.astype(np.dtype(ctx.attr("dtype", "float32"))))
+
+
+@register_op("truncated_gaussian_random")
+def truncated_gaussian_random_kernel(ctx):
+    shape = ctx.attr("shape")
+    mean, std = ctx.attr("mean", 0.0), ctx.attr("std", 1.0)
+    out = mean + std * jax.random.truncated_normal(
+        ctx.rng(), -2.0, 2.0, shape, dtype=jnp.float32
+    )
+    ctx.set_output("Out", out.astype(np.dtype(ctx.attr("dtype", "float32"))))

@@ -1,0 +1,258 @@
+"""NN op kernels: conv, pool, batch_norm, dropout, losses, metrics.
+
+Reference coverage: paddle/operators/{conv_op,pool_op,batch_norm_op,
+dropout_op,cross_entropy_op,softmax_with_cross_entropy_op,accuracy_op,
+lrn_op}.cc plus the Gen-1 kernels they generalize (paddle/function/GemmConvOp,
+gserver/layers/CudnnConvBaseLayer, CostLayer.cpp). Convs map to
+lax.conv_general_dilated (MXU path — XLA lowers conv to systolic-array
+matmuls internally); data layout is NCHW to match the reference API, XLA
+re-layouts for TPU automatically.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.lod import LoDArray
+from ..core.registry import register_op
+
+
+def _pair(v):
+    if isinstance(v, (list, tuple)):
+        return tuple(v)
+    return (v, v)
+
+
+# ------------------------------------------------------------------ conv ---
+@register_op("conv2d")
+def conv2d_kernel(ctx):
+    """Reference: paddle/operators/conv_op.cc (REGISTER_OP conv2d);
+
+    groups/dilation semantics per ConvOp::InferShape."""
+    x = ctx.input("Input")  # [N, C, H, W]
+    w = ctx.input("Filter")  # [out_c, in_c/groups, kh, kw]
+    stride = _pair(ctx.attr("strides", (1, 1)))
+    pad = _pair(ctx.attr("paddings", (0, 0)))
+    dil = _pair(ctx.attr("dilations", (1, 1)))
+    groups = ctx.attr("groups", 1)
+    out = jax.lax.conv_general_dilated(
+        x,
+        w,
+        window_strides=stride,
+        padding=[(pad[0], pad[0]), (pad[1], pad[1])],
+        rhs_dilation=dil,
+        feature_group_count=groups,
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        preferred_element_type=jnp.float32,
+    ).astype(x.dtype)
+    if ctx.has_input("Bias"):
+        out = out + ctx.input("Bias").reshape((1, -1, 1, 1))
+    ctx.set_output("Output", out)
+
+
+@register_op("conv2d_transpose")
+def conv2d_transpose_kernel(ctx):
+    """Reference: paddle/operators/conv_transpose_op.cc."""
+    x = ctx.input("Input")
+    w = ctx.input("Filter")  # [in_c, out_c, kh, kw]
+    stride = _pair(ctx.attr("strides", (1, 1)))
+    pad = _pair(ctx.attr("paddings", (0, 0)))
+    out = jax.lax.conv_transpose(
+        x,
+        jnp.transpose(w, (1, 0, 2, 3)),
+        strides=stride,
+        padding=[(pad[0], pad[0]), (pad[1], pad[1])],
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        transpose_kernel=True,
+    )
+    ctx.set_output("Output", out)
+
+
+# ------------------------------------------------------------------ pool ---
+@register_op("pool2d")
+def pool2d_kernel(ctx):
+    """Reference: paddle/operators/pool_op.cc — max/avg, ksize/strides/
+
+    paddings, global_pooling."""
+    x = ctx.input("X")  # [N, C, H, W]
+    ptype = ctx.attr("pooling_type", "max")
+    ksize = _pair(ctx.attr("ksize", (2, 2)))
+    stride = _pair(ctx.attr("strides", (2, 2)))
+    pad = _pair(ctx.attr("paddings", (0, 0)))
+    if ctx.attr("global_pooling", False):
+        ksize = x.shape[2:4]
+        stride = ksize
+        pad = (0, 0)
+    window = (1, 1) + ksize
+    strides = (1, 1) + stride
+    pads = ((0, 0), (0, 0), (pad[0], pad[0]), (pad[1], pad[1]))
+    if ptype == "max":
+        init = -jnp.inf
+        out = jax.lax.reduce_window(x, init, jax.lax.max, window, strides, pads)
+    else:
+        summed = jax.lax.reduce_window(x, 0.0, jax.lax.add, window, strides, pads)
+        if ctx.attr("exclusive", True) and pad != (0, 0):
+            ones = jnp.ones_like(x)
+            counts = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window, strides, pads)
+            out = summed / counts
+        else:
+            out = summed / float(ksize[0] * ksize[1])
+    ctx.set_output("Out", out)
+
+
+# ------------------------------------------------------------ batch norm ---
+@register_op("batch_norm")
+def batch_norm_kernel(ctx):
+    """Reference: paddle/operators/batch_norm_op.cc. Train mode computes
+
+    batch stats and updates the running mean/var persistables; eval mode
+    consumes them. NCHW: stats over (N, H, W)."""
+    x = ctx.input("X")
+    scale, bias = ctx.input("Scale"), ctx.input("Bias")
+    mean_v, var_v = ctx.input("Mean"), ctx.input("Variance")
+    momentum = ctx.attr("momentum", 0.9)
+    eps = ctx.attr("epsilon", 1e-5)
+    is_test = ctx.attr("is_test", False)
+
+    axes = tuple(i for i in range(x.ndim) if i != 1)
+    shape = (1, -1) + (1,) * (x.ndim - 2)
+    if is_test:
+        mean, var = mean_v, var_v
+    else:
+        mean = jnp.mean(x, axis=axes)
+        var = jnp.var(x, axis=axes)
+        new_mean = momentum * mean_v + (1 - momentum) * mean
+        new_var = momentum * var_v + (1 - momentum) * var
+        # running stats flow back into the Scope as persistables
+        ctx.env[ctx.op.inputs["Mean"][0]] = new_mean
+        ctx.env[ctx.op.inputs["Variance"][0]] = new_var
+    inv = jax.lax.rsqrt(var + eps)
+    out = (x - mean.reshape(shape)) * inv.reshape(shape) * scale.reshape(
+        shape
+    ) + bias.reshape(shape)
+    ctx.set_output("Y", out)
+
+
+@register_op("layer_norm")
+def layer_norm_kernel(ctx):
+    """Reference: paddle/operators/layer_norm_op.cc (added late in v0.11)."""
+    x = ctx.input("X")
+    eps = ctx.attr("epsilon", 1e-5)
+    begin = ctx.attr("begin_norm_axis", 1)
+    axes = tuple(range(begin, x.ndim))
+    mean = jnp.mean(x, axis=axes, keepdims=True)
+    var = jnp.var(x, axis=axes, keepdims=True)
+    out = (x - mean) * jax.lax.rsqrt(var + eps)
+    if ctx.has_input("Scale"):
+        out = out * ctx.input("Scale")
+    if ctx.has_input("Bias"):
+        out = out + ctx.input("Bias")
+    ctx.set_output("Y", out)
+
+
+# --------------------------------------------------------------- dropout ---
+@register_op("dropout")
+def dropout_kernel(ctx):
+    """Reference: paddle/operators/dropout_op.cc — upscale-in-train off
+
+    (reference scales at inference? No: reference multiplies by (1-p) at
+    test time is NOT done; it masks without rescale in train). v0.11
+    semantics: train: out = x * mask, mask ~ Bernoulli(1-p); test:
+    out = x * (1-p)."""
+    x = ctx.input("X")
+    p = ctx.attr("dropout_prob", 0.5)
+    if ctx.attr("is_test", False):
+        ctx.set_output("Out", x * (1.0 - p) if isinstance(x, jnp.ndarray) else x.with_data(x.data * (1.0 - p)))
+        return
+    data = x.data if isinstance(x, LoDArray) else x
+    mask = jax.random.bernoulli(ctx.rng(), 1.0 - p, data.shape)
+    out = data * mask.astype(data.dtype)
+    ctx.set_output("Out", x.with_data(out) if isinstance(x, LoDArray) else out)
+
+
+# ---------------------------------------------------------------- losses ---
+@register_op("cross_entropy")
+def cross_entropy_kernel(ctx):
+    """Reference: paddle/operators/cross_entropy_op.cc — X is a probability
+
+    distribution [N, D]; Label is int [N, 1] (or soft labels [N, D])."""
+    x = ctx.input("X")
+    label = ctx.input("Label")
+    eps = 1e-8
+    if ctx.attr("soft_label", False):
+        out = -jnp.sum(label * jnp.log(x + eps), axis=-1, keepdims=True)
+    else:
+        lbl = label[..., 0] if label.ndim == x.ndim else label
+        picked = jnp.take_along_axis(x, lbl[..., None].astype(jnp.int32), axis=-1)
+        out = -jnp.log(picked + eps)
+    ctx.set_output("Y", out)
+
+
+@register_op("softmax_with_cross_entropy")
+def softmax_with_cross_entropy_kernel(ctx):
+    """Reference: paddle/operators/softmax_with_cross_entropy_op.cc —
+
+    numerically-stable fused version."""
+    logits = ctx.input("Logits")
+    label = ctx.input("Label")
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    if ctx.attr("soft_label", False):
+        loss = -jnp.sum(label * logp, axis=-1, keepdims=True)
+    else:
+        lbl = label[..., 0] if label.ndim == logits.ndim else label
+        loss = -jnp.take_along_axis(logp, lbl[..., None].astype(jnp.int32), axis=-1)
+    ctx.set_output("Softmax", jnp.exp(logp))
+    ctx.set_output("Loss", loss)
+
+
+@register_op("square_error_cost")
+def square_error_cost_kernel(ctx):
+    """Reference: paddle/operators/squared_l2_distance_op.cc /
+
+    gserver CostLayer sum_of_squares."""
+    x, y = ctx.input("X"), ctx.input("Y")
+    ctx.set_output("Out", jnp.square(x - y))
+
+
+@register_op("huber_loss")
+def huber_loss_kernel(ctx):
+    x, y = ctx.input("X"), ctx.input("Y")
+    d = ctx.attr("delta", 1.0)
+    r = y - x
+    a = jnp.abs(r)
+    loss = jnp.where(a <= d, 0.5 * r * r, d * (a - 0.5 * d))
+    ctx.set_output("Out", loss)
+
+
+# --------------------------------------------------------------- metrics ---
+@register_op("accuracy")
+def accuracy_kernel(ctx):
+    """Reference: paddle/operators/accuracy_op.cc — top-k indices vs label."""
+    indices = ctx.input("Indices")  # [N, k] from top_k
+    label = ctx.input("Label")  # [N, 1]
+    correct = jnp.any(indices == label.astype(indices.dtype), axis=-1)
+    ctx.set_output("Accuracy", jnp.mean(correct.astype(jnp.float32)))
+    if ctx.has_output("Correct"):
+        ctx.set_output("Correct", jnp.sum(correct.astype(jnp.int64)))
+    if ctx.has_output("Total"):
+        ctx.set_output("Total", jnp.asarray(indices.shape[0], jnp.int64))
+
+
+# ------------------------------------------------------------------- lrn ---
+@register_op("lrn")
+def lrn_kernel(ctx):
+    """Reference: paddle/operators/lrn_op.cc — local response norm across
+
+    channels (AlexNet/GoogleNet)."""
+    x = ctx.input("X")  # [N, C, H, W]
+    n = ctx.attr("n", 5)
+    k = ctx.attr("k", 2.0)
+    alpha = ctx.attr("alpha", 1e-4)
+    beta = ctx.attr("beta", 0.75)
+    sq = jnp.square(x)
+    half = n // 2
+    pad = jnp.pad(sq, ((0, 0), (half, half), (0, 0), (0, 0)))
+    windows = sum(pad[:, i : i + x.shape[1]] for i in range(n))
+    ctx.set_output("Out", x / jnp.power(k + alpha * windows, beta))

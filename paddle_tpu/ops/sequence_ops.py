@@ -1,0 +1,128 @@
+"""Sequence (ragged/LoD) op kernels.
+
+Reference coverage: paddle/operators/{sequence_pool_op,sequence_softmax_op,
+sequence_expand_op,sequence_concat_op,sequence_slice_op,sequence_conv_op}.cc,
+Gen-1 gserver/layers/{SequencePoolLayer,ExpandLayer}.cpp, and the segment
+machinery in paddle/cuda/src/hl_cuda_sequence.cu. All operate on LoDArray
+(core/lod.py): segment reductions over `seq_ids` — the TPU-native encoding
+of the reference's no-padding sequenceStartPositions design
+(parameter/Argument.h:84-90).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.lod import LoDArray
+from ..core.registry import register_op
+
+
+def _segment_max_ids(x: LoDArray):
+    return jnp.where(x.seq_ids >= 0, x.seq_ids, x.max_seqs)
+
+
+def segment_reduce(x: LoDArray, mode: str):
+    """[capacity, ...] → [max_seqs, ...] per-sequence reduction."""
+    ids = _segment_max_ids(x)
+    num = x.max_seqs
+    if mode == "sum":
+        return jax.ops.segment_sum(x.data, ids, num_segments=num + 1)[:num]
+    if mode == "average":
+        s = jax.ops.segment_sum(x.data, ids, num_segments=num + 1)[:num]
+        cnt = jnp.maximum(x.lengths, 1).astype(s.dtype)
+        return s / cnt.reshape((-1,) + (1,) * (s.ndim - 1))
+    if mode == "sqrt":
+        s = jax.ops.segment_sum(x.data, ids, num_segments=num + 1)[:num]
+        cnt = jnp.maximum(x.lengths, 1).astype(s.dtype)
+        return s / jnp.sqrt(cnt).reshape((-1,) + (1,) * (s.ndim - 1))
+    if mode == "max":
+        return jax.ops.segment_max(x.data, ids, num_segments=num + 1)[:num]
+    if mode == "min":
+        return jax.ops.segment_min(x.data, ids, num_segments=num + 1)[:num]
+    if mode == "last":
+        idx = jnp.clip(x.offsets[1:] - 1, 0, x.capacity - 1)
+        return jnp.take(x.data, idx, axis=0)
+    if mode == "first":
+        idx = jnp.clip(x.offsets[:-1], 0, x.capacity - 1)
+        return jnp.take(x.data, idx, axis=0)
+    raise NotImplementedError(f"sequence_pool mode {mode!r}")
+
+
+@register_op("sequence_pool")
+def sequence_pool_kernel(ctx):
+    """Reference: sequence_pool_op.cc / gserver SequencePoolLayer.cpp —
+
+    modes: average, sum, sqrt, max, last, first."""
+    x = ctx.input("X")
+    mode = ctx.attr("pooltype", "sum").lower()
+    out = segment_reduce(x, mode)
+    # zero out absent sequences
+    valid = (jnp.arange(x.max_seqs) < x.num_seqs).reshape(
+        (-1,) + (1,) * (out.ndim - 1)
+    )
+    ctx.set_output("Out", jnp.where(valid, out, 0.0))
+
+
+def sequence_softmax_impl(x: LoDArray) -> LoDArray:
+    """Softmax within each sequence (reference: sequence_softmax_op.cc,
+
+    Gen-1 sequence_softmax activation). x.data: [capacity] or [capacity, 1].
+    """
+    data = x.data
+    squeeze = False
+    if data.ndim == 2 and data.shape[1] == 1:
+        data = data[:, 0]
+        squeeze = True
+    ids = _segment_max_ids(x)
+    num = x.max_seqs
+    data = jnp.where(x.token_mask, data, -jnp.inf)
+    seg_max = jax.ops.segment_max(data, ids, num_segments=num + 1)
+    shifted = data - jnp.take(seg_max, ids)
+    e = jnp.where(x.token_mask, jnp.exp(shifted), 0.0)
+    seg_sum = jax.ops.segment_sum(e, ids, num_segments=num + 1)
+    out = e / jnp.maximum(jnp.take(seg_sum, ids), 1e-20)
+    if squeeze:
+        out = out[:, None]
+    return x.with_data(out)
+
+
+@register_op("sequence_softmax")
+def sequence_softmax_kernel(ctx):
+    ctx.set_output("Out", sequence_softmax_impl(ctx.input("X")))
+
+
+@register_op("sequence_expand")
+def sequence_expand_kernel(ctx):
+    """Reference: sequence_expand_op.cc / gserver ExpandLayer.cpp — broadcast
+
+    per-sequence rows of X across the tokens of Y's sequences."""
+    x = ctx.input("X")  # dense [max_seqs, ...] or LoDArray
+    y = ctx.input("Y")  # LoDArray giving the target lod
+    rows = x.data if isinstance(x, LoDArray) else x
+    ids = jnp.clip(y.seq_ids, 0, rows.shape[0] - 1)
+    out = jnp.take(rows, ids, axis=0)
+    out = jnp.where(
+        y.token_mask.reshape((-1,) + (1,) * (out.ndim - 1)), out, 0.0
+    )
+    ctx.set_output("Out", y.with_data(out))
+
+
+@register_op("sequence_concat")
+def sequence_concat_kernel(ctx):
+    """Reference: sequence_concat_op.cc — feature-axis concat of LoD inputs
+
+    with identical lod (axis=1)."""
+    xs = ctx.inputs("X")
+    datas = [x.data for x in xs]
+    ctx.set_output("Out", xs[0].with_data(jnp.concatenate(datas, axis=-1)))
+
+
+@register_op("sequence_first_step")
+def sequence_first_step_kernel(ctx):
+    ctx.set_output("Out", segment_reduce(ctx.input("X"), "first"))
+
+
+@register_op("sequence_last_step")
+def sequence_last_step_kernel(ctx):
+    ctx.set_output("Out", segment_reduce(ctx.input("X"), "last"))

@@ -1,0 +1,35 @@
+"""ParamAttr: per-parameter configuration.
+
+Reference: python/paddle/v2/fluid/param_attr.py — name, initializer,
+learning_rate multiplier, regularizer, trainable, gradient clip; same fields
+here, consumed by LayerHelper.create_parameter (layers/helper.py).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Optional
+
+
+@dataclass
+class ParamAttr:
+    name: Optional[str] = None
+    initializer: Any = None
+    learning_rate: float = 1.0
+    regularizer: Any = None
+    trainable: bool = True
+    gradient_clip: Any = None
+
+    @staticmethod
+    def to_attr(arg) -> "ParamAttr":
+        if arg is None:
+            return ParamAttr()
+        if isinstance(arg, ParamAttr):
+            return arg
+        if isinstance(arg, str):
+            return ParamAttr(name=arg)
+        if isinstance(arg, (list, tuple)):
+            return [ParamAttr.to_attr(a) for a in arg]
+        if arg is False:
+            return False  # explicit "no parameter" (e.g. bias_attr=False)
+        raise TypeError(f"cannot convert {arg!r} to ParamAttr")

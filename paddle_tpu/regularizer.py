@@ -1,0 +1,59 @@
+"""Weight-decay regularizers.
+
+Reference: python/paddle/v2/fluid/regularizer.py (L1DecayRegularizer,
+L2DecayRegularizer append decay ops onto the gradient) and Gen-1
+paddle/parameter/Regularizer.cpp. Here each regularizer appends ops that
+produce grad' = grad + decay_term(param).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from .layers.helper import LayerHelper
+
+
+@dataclass
+class L2DecayRegularizer:
+    regularization_coeff: float = 0.0
+
+    def append_decay(self, param, grad):
+        helper = LayerHelper("l2_decay")
+        scaled = helper.create_tmp_variable(param.dtype, param.shape)
+        helper.append_op(
+            type="scale", inputs={"X": [param]}, outputs={"Out": [scaled]},
+            attrs={"scale": self.regularization_coeff},
+        )
+        out = helper.create_tmp_variable(grad.dtype, grad.shape)
+        helper.append_op(
+            type="elementwise_add", inputs={"X": [grad], "Y": [scaled]},
+            outputs={"Out": [out]},
+        )
+        return out
+
+
+@dataclass
+class L1DecayRegularizer:
+    regularization_coeff: float = 0.0
+
+    def append_decay(self, param, grad):
+        helper = LayerHelper("l1_decay")
+        sign = helper.create_tmp_variable(param.dtype, param.shape)
+        helper.append_op(
+            type="sign", inputs={"X": [param]}, outputs={"Out": [sign]},
+        )
+        scaled = helper.create_tmp_variable(param.dtype, param.shape)
+        helper.append_op(
+            type="scale", inputs={"X": [sign]}, outputs={"Out": [scaled]},
+            attrs={"scale": self.regularization_coeff},
+        )
+        out = helper.create_tmp_variable(grad.dtype, grad.shape)
+        helper.append_op(
+            type="elementwise_add", inputs={"X": [grad], "Y": [scaled]},
+            outputs={"Out": [out]},
+        )
+        return out
+
+
+L1Decay = L1DecayRegularizer
+L2Decay = L2DecayRegularizer

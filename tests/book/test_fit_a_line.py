@@ -1,0 +1,33 @@
+"""Book 01: linear regression on UCI housing.
+
+Reference acceptance test: python/paddle/v2/fluid/tests/book/
+test_fit_a_line.py — builds fc(1) + square_error_cost + SGD and asserts the
+loss drops below 10 within the pass budget.
+"""
+
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu.data import batch, shuffle
+from paddle_tpu.data.datasets import uci_housing
+
+
+def test_fit_a_line():
+    x = pt.layers.data("x", shape=[13])
+    y = pt.layers.data("y", shape=[1])
+    y_predict = pt.layers.fc(x, size=1)
+    cost = pt.layers.square_error_cost(y_predict, y)
+    avg_cost = pt.layers.mean(cost)
+    pt.optimizer.SGD(learning_rate=0.01).minimize(avg_cost)
+
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+
+    train_reader = batch(shuffle(uci_housing.train(), 500, seed=0), 20, drop_last=True)
+    last = None
+    for _pass in range(15):
+        for data in train_reader():
+            xs = np.stack([d[0] for d in data])
+            ys = np.stack([d[1] for d in data])
+            (last,) = exe.run(feed={"x": xs, "y": ys}, fetch_list=[avg_cost])
+    assert last is not None and float(last) < 1.0, f"did not converge: {last}"

@@ -1,0 +1,36 @@
+"""Test harness config.
+
+Tests run on a simulated 8-device CPU mesh
+(--xla_force_host_platform_device_count=8, the JAX analogue of the
+reference's in-process multi-GPU/pserver tests — SURVEY.md §4.5) so
+multi-chip sharding is exercised without TPU hardware. bench.py and
+__graft_entry__.py do NOT import this and use the real TPU.
+
+The ambient environment points JAX at the axon TPU tunnel
+(JAX_PLATFORMS=axon, single-client) — tests must never touch it, and the
+sitecustomize hook registers the plugin before conftest runs, so we both
+set the env var and force the platform through jax.config.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        xla_flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def fresh_state():
+    import paddle_tpu as pt
+
+    pt.reset()
+    yield
