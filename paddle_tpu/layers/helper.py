@@ -85,7 +85,3 @@ class LayerHelper:
             attrs=attrs or {},
         )
         return tmp
-
-    def bias_attr_or_false(self):
-        ba = self.kwargs.get("bias_attr")
-        return ba

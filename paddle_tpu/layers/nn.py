@@ -150,6 +150,23 @@ def embedding(
     return out
 
 
+def _pair_(v):
+    return tuple(v) if isinstance(v, (list, tuple)) else (v, v)
+
+
+def _conv_out_hw(hw, ksize, stride, padding, dilation=1):
+    """Static NCHW output spatial dims; -1 propagates unknowns."""
+    k, s, p, d = _pair_(ksize), _pair_(stride), _pair_(padding), _pair_(dilation)
+    out = []
+    for i in range(2):
+        if hw[i] == -1:
+            out.append(-1)
+        else:
+            eff = d[i] * (k[i] - 1) + 1
+            out.append((hw[i] + 2 * p[i] - eff) // s[i] + 1)
+    return tuple(out)
+
+
 def conv2d(
     input,
     num_filters: int,
@@ -166,7 +183,7 @@ def conv2d(
     """Reference: fluid layers/nn.py:772 `conv2d`; Gen-1 img_conv_layer."""
     helper = LayerHelper("conv2d", name=name)
     in_c = input.shape[1]
-    fh, fw = (filter_size, filter_size) if isinstance(filter_size, int) else filter_size
+    fh, fw = _pair_(filter_size)
     w_shape = (num_filters, in_c // groups, fh, fw)
     fan_in = (in_c // groups) * fh * fw
     std = (2.0 / fan_in) ** 0.5
@@ -177,7 +194,10 @@ def conv2d(
     if bias_attr is not False:
         b = helper.create_parameter(bias_attr, (num_filters,), is_bias=True)
         inputs["Bias"] = [b]
-    out = helper.create_tmp_variable(input.dtype, (-1, num_filters, -1, -1))
+    out = helper.create_tmp_variable(
+        input.dtype,
+        (-1, num_filters) + _conv_out_hw(input.shape[2:4], (fh, fw), stride, padding, dilation),
+    )
     helper.append_op(
         type="conv2d",
         inputs=inputs,
@@ -197,9 +217,15 @@ def conv2d_transpose(
 ) -> Variable:
     helper = LayerHelper("conv2d_transpose", name=name)
     in_c = input.shape[1]
-    fh, fw = (filter_size, filter_size) if isinstance(filter_size, int) else filter_size
+    fh, fw = _pair_(filter_size)
     w = helper.create_parameter(param_attr, (in_c, num_filters, fh, fw))
-    out = helper.create_tmp_variable(input.dtype, (-1, num_filters, -1, -1))
+    s, p = _pair_(stride), _pair_(padding)
+    out_hw = tuple(
+        -1 if input.shape[2 + i] == -1
+        else (input.shape[2 + i] - 1) * s[i] - 2 * p[i] + (fh, fw)[i]
+        for i in range(2)
+    )
+    out = helper.create_tmp_variable(input.dtype, (-1, num_filters) + out_hw)
     helper.append_op(
         type="conv2d_transpose",
         inputs={"Input": [input], "Filter": [w]},
@@ -221,7 +247,16 @@ def pool2d(
 ) -> Variable:
     """Reference: fluid layers/nn.py `pool2d` / pool_op.cc."""
     helper = LayerHelper("pool2d", name=name)
-    out = helper.create_tmp_variable(input.dtype, (-1, input.shape[1], -1, -1))
+    if global_pooling:
+        out_hw = (1, 1)
+    else:
+        out_hw = _conv_out_hw(
+            input.shape[2:4],
+            pool_size,
+            pool_stride if pool_stride is not None else pool_size,
+            pool_padding,
+        )
+    out = helper.create_tmp_variable(input.dtype, (-1, input.shape[1]) + out_hw)
     helper.append_op(
         type="pool2d",
         inputs={"X": [input]},
@@ -255,11 +290,15 @@ def batch_norm(
         param_attr, (c,), default_initializer=ConstantInitializer(1.0)
     )
     bias = helper.create_parameter(bias_attr, (c,), is_bias=True)
+    from ..param_attr import ParamAttr as _PA
+
     mean = helper.create_parameter(
-        None, (c,), default_initializer=ConstantInitializer(0.0)
+        _PA(name=f"{helper.name}.mean"), (c,),
+        default_initializer=ConstantInitializer(0.0),
     )
     var = helper.create_parameter(
-        None, (c,), default_initializer=ConstantInitializer(1.0)
+        _PA(name=f"{helper.name}.variance"), (c,),
+        default_initializer=ConstantInitializer(1.0),
     )
     # running stats are state, not trainable weights
     mean.trainable = False
@@ -352,7 +391,7 @@ def accuracy(input, label, k: int = 1) -> Variable:
     """Reference: fluid layers accuracy — topk + accuracy op."""
     helper = LayerHelper("accuracy")
     vals = helper.create_tmp_variable(input.dtype, input.shape[:-1] + (k,))
-    idxs = helper.create_tmp_variable(np.int64, input.shape[:-1] + (k,))
+    idxs = helper.create_tmp_variable(np.int32, input.shape[:-1] + (k,))
     helper.append_op(
         type="top_k",
         inputs={"X": [input]},
@@ -492,7 +531,7 @@ def expand(x, expand_times):
 def topk(input, k=1):
     helper = LayerHelper("top_k")
     vals = helper.create_tmp_variable(input.dtype, input.shape[:-1] + (k,))
-    idxs = helper.create_tmp_variable(np.int64, input.shape[:-1] + (k,))
+    idxs = helper.create_tmp_variable(np.int32, input.shape[:-1] + (k,))
     helper.append_op(
         type="top_k", inputs={"X": [input]},
         outputs={"Out": [vals], "Indices": [idxs]}, attrs={"k": k},
@@ -502,7 +541,7 @@ def topk(input, k=1):
 
 def argmax(x, axis=-1):
     helper = LayerHelper("argmax")
-    out = helper.create_tmp_variable(np.int64, x.shape[:-1])
+    out = helper.create_tmp_variable(np.int32, x.shape[:-1])
     helper.append_op(
         type="argmax", inputs={"X": [x]}, outputs={"Out": [out]},
         attrs={"axis": axis},

@@ -245,7 +245,7 @@ def top_k_kernel(ctx):
     k = ctx.attr("k", 1)
     vals, idxs = jax.lax.top_k(x, k)
     ctx.set_output("Out", vals)
-    ctx.set_output("Indices", idxs.astype(jnp.int64))
+    ctx.set_output("Indices", idxs.astype(jnp.int32))
 
 
 @register_op("lookup_table")
@@ -289,7 +289,7 @@ def increment_kernel(ctx):
 @register_op("argmax")
 def argmax_kernel(ctx):
     x = _data(ctx.input("X"))
-    ctx.set_output("Out", jnp.argmax(x, axis=ctx.attr("axis", -1)).astype(jnp.int64))
+    ctx.set_output("Out", jnp.argmax(x, axis=ctx.attr("axis", -1)).astype(jnp.int32))
 
 
 # ------------------------------------------------------------ initializers -

