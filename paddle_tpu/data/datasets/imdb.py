@@ -1,0 +1,118 @@
+"""IMDB sentiment dataset (reference: python/paddle/v2/dataset/imdb.py).
+
+Sample schema: (word_ids list[int], label 0/1). With no egress, synthesizes
+variable-length reviews from two class-conditional token distributions
+(positive reviews over-sample the first vocab half), so stacked-LSTM /
+conv sentiment models can learn the classes. word_dict() returns a vocab
+of the same shape as the reference API.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+import re
+
+import numpy as np
+
+from . import data_home
+
+_VOCAB = 5147  # reference: imdb word dict ~5147 after cutoff
+_N_TRAIN, _N_TEST = 2000, 400
+
+
+def _real_dir():
+    d = os.path.join(data_home(), "imdb", "aclImdb")
+    return d if os.path.isdir(d) else None
+
+
+_word_dict_cache = None
+
+
+def _tokenize(text):
+    return re.sub(r"[^a-z0-9 ]", " ", text.lower()).split()
+
+
+def _build_real_dict(root):
+    from collections import Counter
+
+    cnt = Counter()
+    for path in glob.glob(os.path.join(root, "train", "*", "*.txt")):
+        with open(path, errors="ignore") as f:
+            cnt.update(_tokenize(f.read()))
+    words = [w for w, c in cnt.most_common() if c > 30]
+    return {w: i for i, w in enumerate(words)}
+
+
+def word_dict():
+    """Reference: imdb.word_dict() — token → id. Uses real aclImdb data
+
+    under data_home()/imdb/aclImdb when present, else a synthetic vocab."""
+    global _word_dict_cache
+    if _word_dict_cache is None:
+        root = _real_dir()
+        _word_dict_cache = (
+            _build_real_dict(root) if root else {f"w{i}": i for i in range(_VOCAB)}
+        )
+    return _word_dict_cache
+
+
+def _real_reader(split):
+    root = _real_dir()
+    wd = word_dict()
+    unk = len(wd)
+
+    def reader():
+        for label, sub in ((1, "pos"), (0, "neg")):
+            for path in sorted(glob.glob(os.path.join(root, split, sub, "*.txt"))):
+                with open(path, errors="ignore") as f:
+                    ids = [wd.get(w, unk) for w in _tokenize(f.read())]
+                yield ids, label
+
+    return reader
+
+
+def _make(n, seed):
+    rng = np.random.RandomState(seed)
+    samples = []
+    half = _VOCAB // 2
+    for _ in range(n):
+        label = int(rng.randint(0, 2))
+        length = int(rng.randint(8, 120))
+        # class-dependent mixture: label 1 prefers low ids, 0 prefers high
+        if label == 1:
+            ids = np.where(
+                rng.rand(length) < 0.8,
+                rng.randint(0, half, length),
+                rng.randint(half, _VOCAB, length),
+            )
+        else:
+            ids = np.where(
+                rng.rand(length) < 0.8,
+                rng.randint(half, _VOCAB, length),
+                rng.randint(0, half, length),
+            )
+        samples.append((ids.astype(np.int32).tolist(), label))
+    return samples
+
+
+def train(word_idx=None):
+    if _real_dir():
+        return _real_reader("train")
+
+    def reader():
+        for ids, label in _make(_N_TRAIN, seed=0):
+            yield ids, label
+
+    return reader
+
+
+def test(word_idx=None):
+    if _real_dir():
+        return _real_reader("test")
+
+    def reader():
+        for ids, label in _make(_N_TEST, seed=1):
+            yield ids, label
+
+    return reader

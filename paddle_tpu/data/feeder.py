@@ -1,0 +1,51 @@
+"""DataFeeder: host batches → device-ready feed dicts.
+
+Reference: python/paddle/v2/fluid/data_feeder.py and
+paddle/py_paddle/dataprovider_converter.py:25-125 (dense / index /
+sequence scanners building Arguments). Here dense slots stack to arrays
+and lod_level=1 slots build LoDArray with *bucketed* capacity so XLA
+recompiles only when a batch overflows the current bucket (the TPU answer
+to the reference's no-padding variable-length batches).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Sequence
+
+import numpy as np
+
+from ..core.lod import LoDArray
+from ..core.program import Variable
+
+
+class DataFeeder:
+    def __init__(self, feed_list: Sequence[Variable], bucket: int = 256,
+                 max_seqs: int = None):
+        self.feed_list = list(feed_list)
+        self.bucket = bucket
+        self.max_seqs = max_seqs
+
+    def feed(self, batch: List[Sequence]) -> Dict[str, object]:
+        """batch: list of samples, each a tuple aligned with feed_list."""
+        out = {}
+        for slot_idx, var in enumerate(self.feed_list):
+            vals = [sample[slot_idx] for sample in batch]
+            if var.lod_level == 0:
+                arr = np.asarray(vals, dtype=np.dtype(var.dtype))
+                want = tuple(d for d in var.shape if d != -1)
+                if arr.ndim == 1 and want:
+                    arr = arr.reshape((len(batch),) + want)
+                out[var.name] = arr
+            else:
+                seqs = [
+                    np.asarray(v, dtype=np.dtype(var.dtype)).reshape(
+                        (-1,) + tuple(d for d in var.shape[1:] if d != -1)
+                    )
+                    for v in vals
+                ]
+                out[var.name] = LoDArray.from_sequences(
+                    seqs,
+                    bucket=self.bucket,
+                    max_seqs=self.max_seqs or len(batch),
+                )
+        return out

@@ -5,5 +5,7 @@ front-end over core.program + ops."""
 
 from .nn import *  # noqa: F401,F403
 from .nn import __all__ as _nn_all
+from .sequence import *  # noqa: F401,F403
+from .sequence import __all__ as _seq_all
 
-__all__ = list(_nn_all)
+__all__ = list(_nn_all) + list(_seq_all)

@@ -1,0 +1,190 @@
+"""Sequence layer DSL: ragged-batch (LoD) layers.
+
+Reference: fluid layers/nn.py (dynamic_lstm :227, dynamic_gru,
+sequence_pool family) and Gen-1 trainer_config_helpers/layers.py
+(lstmemory, grumemory, pooling_layer, expand_layer, first_seq/last_seq).
+All operate on lod_level=1 variables whose runtime value is a LoDArray.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from ..initializer import XavierInitializer
+from .helper import LayerHelper
+
+__all__ = [
+    "dynamic_lstm",
+    "dynamic_gru",
+    "simple_rnn",
+    "sequence_pool",
+    "sequence_softmax",
+    "sequence_expand",
+    "sequence_concat",
+    "sequence_first_step",
+    "sequence_last_step",
+]
+
+
+def dynamic_lstm(
+    input,
+    size: int,
+    use_peepholes: bool = False,
+    is_reverse: bool = False,
+    gate_activation: str = "sigmoid",
+    cell_activation: str = "tanh",
+    candidate_activation: str = "tanh",
+    param_attr=None,
+    bias_attr=None,
+    max_len: Optional[int] = None,
+    name=None,
+):
+    """Reference: fluid layers/nn.py:227 dynamic_lstm — `size` is 4*hidden
+
+    and `input` must already be the [*, 4H] projection (use fc before).
+
+    `max_len` bounds the scan length (compile-time constant). It MUST be
+    >= the longest sequence in any batch: timesteps beyond max_len are
+    silently dropped (their hidden states stay zero). Default: the
+    LoDArray capacity, which is always safe but scans padding."""
+    helper = LayerHelper("dynamic_lstm", name=name)
+    hidden = size // 4
+    w = helper.create_parameter(param_attr, (hidden, 4 * hidden),
+                                default_initializer=XavierInitializer())
+    bias_len = 4 * hidden + (3 * hidden if use_peepholes else 0)
+    inputs = {"Input": [input], "Weight": [w]}
+    if bias_attr is not False:
+        inputs["Bias"] = [helper.create_parameter(bias_attr, (bias_len,), is_bias=True)]
+    out = helper.create_tmp_variable(input.dtype, (-1, hidden), lod_level=1)
+    last_h = helper.create_tmp_variable(input.dtype, (-1, hidden))
+    last_c = helper.create_tmp_variable(input.dtype, (-1, hidden))
+    helper.append_op(
+        type="dynamic_lstm",
+        inputs=inputs,
+        outputs={"Hidden": [out], "LastH": [last_h], "LastC": [last_c]},
+        attrs={
+            "use_peepholes": use_peepholes,
+            "is_reverse": is_reverse,
+            "gate_activation": gate_activation,
+            "cell_activation": cell_activation,
+            "candidate_activation": candidate_activation,
+            "max_len": max_len,
+        },
+    )
+    return out
+
+
+def dynamic_gru(
+    input,
+    size: int,
+    is_reverse: bool = False,
+    gate_activation: str = "sigmoid",
+    candidate_activation: str = "tanh",
+    param_attr=None,
+    bias_attr=None,
+    max_len: Optional[int] = None,
+    name=None,
+):
+    """Reference: fluid dynamic_gru — `size` is hidden; input is [*, 3H]."""
+    helper = LayerHelper("dynamic_gru", name=name)
+    w = helper.create_parameter(param_attr, (size, 3 * size),
+                                default_initializer=XavierInitializer())
+    inputs = {"Input": [input], "Weight": [w]}
+    if bias_attr is not False:
+        inputs["Bias"] = [helper.create_parameter(bias_attr, (3 * size,), is_bias=True)]
+    out = helper.create_tmp_variable(input.dtype, (-1, size), lod_level=1)
+    last_h = helper.create_tmp_variable(input.dtype, (-1, size))
+    helper.append_op(
+        type="dynamic_gru",
+        inputs=inputs,
+        outputs={"Hidden": [out], "LastH": [last_h]},
+        attrs={
+            "is_reverse": is_reverse,
+            "gate_activation": gate_activation,
+            "candidate_activation": candidate_activation,
+            "max_len": max_len,
+        },
+    )
+    return out
+
+
+def simple_rnn(input, size: int, activation: str = "tanh", param_attr=None,
+               bias_attr=None, max_len: Optional[int] = None, name=None):
+    """Gen-1 RecurrentLayer parity: h_t = act(x_t + h_{t-1} W)."""
+    helper = LayerHelper("simple_rnn", name=name)
+    w = helper.create_parameter(param_attr, (size, size),
+                                default_initializer=XavierInitializer())
+    inputs = {"Input": [input], "Weight": [w]}
+    if bias_attr is not False:
+        inputs["Bias"] = [helper.create_parameter(bias_attr, (size,), is_bias=True)]
+    out = helper.create_tmp_variable(input.dtype, (-1, size), lod_level=1)
+    helper.append_op(
+        type="simple_rnn",
+        inputs=inputs,
+        outputs={"Hidden": [out]},
+        attrs={"activation": activation, "max_len": max_len},
+    )
+    return out
+
+
+def sequence_pool(input, pool_type: str = "sum", name=None):
+    """Reference: fluid sequence_pool / Gen-1 SequencePoolLayer — returns
+
+    a dense [num_seqs, D] tensor."""
+    helper = LayerHelper("sequence_pool", name=name)
+    out = helper.create_tmp_variable(input.dtype, (-1,) + tuple(input.shape[1:]))
+    helper.append_op(
+        type="sequence_pool",
+        inputs={"X": [input]},
+        outputs={"Out": [out]},
+        attrs={"pooltype": pool_type},
+    )
+    return out
+
+
+def sequence_softmax(input, name=None):
+    helper = LayerHelper("sequence_softmax", name=name)
+    out = helper.create_tmp_variable(input.dtype, input.shape, lod_level=1)
+    helper.append_op(
+        type="sequence_softmax", inputs={"X": [input]}, outputs={"Out": [out]}
+    )
+    return out
+
+
+def sequence_expand(x, y, name=None):
+    """Broadcast per-sequence rows of dense x across tokens of ragged y."""
+    helper = LayerHelper("sequence_expand", name=name)
+    out = helper.create_tmp_variable(x.dtype, x.shape, lod_level=1)
+    helper.append_op(
+        type="sequence_expand", inputs={"X": [x], "Y": [y]}, outputs={"Out": [out]}
+    )
+    return out
+
+
+def sequence_concat(input, name=None):
+    helper = LayerHelper("sequence_concat", name=name)
+    out = helper.create_tmp_variable(input[0].dtype, input[0].shape, lod_level=1)
+    helper.append_op(
+        type="sequence_concat", inputs={"X": list(input)}, outputs={"Out": [out]}
+    )
+    return out
+
+
+def sequence_first_step(input, name=None):
+    helper = LayerHelper("sequence_first_step", name=name)
+    out = helper.create_tmp_variable(input.dtype, (-1,) + tuple(input.shape[1:]))
+    helper.append_op(
+        type="sequence_first_step", inputs={"X": [input]}, outputs={"Out": [out]}
+    )
+    return out
+
+
+def sequence_last_step(input, name=None):
+    helper = LayerHelper("sequence_last_step", name=name)
+    out = helper.create_tmp_variable(input.dtype, (-1,) + tuple(input.shape[1:]))
+    helper.append_op(
+        type="sequence_last_step", inputs={"X": [input]}, outputs={"Out": [out]}
+    )
+    return out

@@ -10,5 +10,6 @@ from . import activation_ops  # noqa: F401
 from . import math_ops  # noqa: F401
 from . import nn_ops  # noqa: F401
 from . import optimizer_ops  # noqa: F401
+from . import rnn_ops  # noqa: F401
 from . import sequence_ops  # noqa: F401
 from ..core.registry import registered_ops  # noqa: F401

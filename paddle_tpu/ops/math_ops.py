@@ -36,7 +36,8 @@ def mul_kernel(ctx):
 
     x_num_col_dims then GEMM (math/math_function matmul → cuBLAS; here MXU).
     """
-    x, y = _data(ctx.input("X")), _data(ctx.input("Y"))
+    x_in = ctx.input("X")
+    x, y = _data(x_in), _data(ctx.input("Y"))
     xd = ctx.attr("x_num_col_dims", 1)
     yd = ctx.attr("y_num_col_dims", 1)
     xs, ys = x.shape, y.shape
@@ -47,7 +48,7 @@ def mul_kernel(ctx):
     out_shape = tuple(xs[:xd]) + tuple(ys[yd:])
     if out.shape != out_shape:
         out = out.reshape(out_shape)
-    ctx.set_output("Out", out.astype(x.dtype))
+    ctx.set_output("Out", _like(x_in, out.astype(x.dtype)))
 
 
 @register_op("matmul")

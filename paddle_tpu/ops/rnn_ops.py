@@ -1,0 +1,227 @@
+"""Recurrent op kernels: LSTM / GRU / simple RNN over ragged batches.
+
+Reference: paddle/operators/lstm_op.cc + operators/math/lstm_compute (the
+fused cell math), cuda/include/hl_gpu_lstm.cuh / hl_lstm.h:42
+(hl_lstm_parallel_forward — the hand-fused per-timestep CUDA kernels), and
+Gen-1 gserver/layers/LstmLayer.cpp / GatedRecurrentLayer.cpp.
+
+TPU design: the reference reorders ragged sequences into per-timestep
+dense batches (sequence2batch) and launches one fused kernel per step.
+Here the same layout transform happens once (LoDArray.to_batch), then a
+single `lax.scan` carries (h, c) across timesteps — XLA fuses the gate
+matmul + elementwise into one MXU-friendly loop body, which is exactly
+what hl_lstm_parallel_forward hand-wrote. Padding steps are masked so the
+carry freezes past each sequence's end (no-padding semantics preserved).
+
+Gate layout in the packed 4H weight/bias: [i, f, g(candidate), o]; GRU
+packed 3H: [u(update), r(reset), c(candidate)].
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.lod import LoDArray
+from ..core.registry import register_op
+from .activation_ops import _ACTIVATIONS
+
+
+def _act(name):
+    if name == "identity" or name is None:
+        return lambda v: v
+    fn = _ACTIVATIONS[name]
+    return lambda v: fn(v, {})
+
+
+def lstm_scan(
+    x_tbh,  # [T, B, 4H] projected input
+    mask,  # [T, B]
+    w_rec,  # [H, 4H]
+    bias,  # [4H] or None
+    w_peephole=None,  # [3H] (Wic, Wfc, Woc) or None
+    h0=None,
+    c0=None,
+    gate_act="sigmoid",
+    cell_act="tanh",
+    cand_act="tanh",
+    reverse=False,
+):
+    """Core masked LSTM scan. Returns (h_seq [T,B,H], (h_T, c_T))."""
+    T, B, H4 = x_tbh.shape
+    H = H4 // 4
+    ga, ca, da = _act(gate_act), _act(cell_act), _act(cand_act)
+    h0 = jnp.zeros((B, H), x_tbh.dtype) if h0 is None else h0
+    c0 = jnp.zeros((B, H), x_tbh.dtype) if c0 is None else c0
+    if reverse:
+        x_tbh = x_tbh[::-1]
+        mask = mask[::-1]
+    if w_peephole is not None:
+        w_ic, w_fc, w_oc = jnp.split(w_peephole, 3)
+    else:
+        w_ic = w_fc = w_oc = None
+
+    def step(carry, inp):
+        h_prev, c_prev = carry
+        x_t, m_t = inp
+        gates = x_t + jnp.dot(
+            h_prev, w_rec, preferred_element_type=jnp.float32
+        ).astype(x_t.dtype)
+        if bias is not None:
+            gates = gates + bias
+        i, f, g, o = jnp.split(gates, 4, axis=-1)
+        if w_ic is not None:
+            i = i + c_prev * w_ic
+            f = f + c_prev * w_fc
+        i, f = ga(i), ga(f)
+        c = f * c_prev + i * da(g)
+        if w_oc is not None:
+            o = o + c * w_oc
+        o = ga(o)
+        h = o * ca(c)
+        m = m_t[:, None].astype(x_t.dtype)
+        h = m * h + (1 - m) * h_prev
+        c = m * c + (1 - m) * c_prev
+        return (h, c), h
+
+    (h_T, c_T), h_seq = jax.lax.scan(step, (h0, c0), (x_tbh, mask))
+    if reverse:
+        h_seq = h_seq[::-1]
+    return h_seq, (h_T, c_T)
+
+
+def gru_scan(
+    x_tbh,  # [T, B, 3H]
+    mask,  # [T, B]
+    w_rec,  # [H, 2H] for update/reset + [H, H] candidate packed as [H, 3H]
+    bias,  # [3H] or None
+    h0=None,
+    gate_act="sigmoid",
+    cand_act="tanh",
+    reverse=False,
+):
+    """Masked GRU scan (reference: operators/gru_op.cc, hl_gpu_gru.cuh)."""
+    T, B, H3 = x_tbh.shape
+    H = H3 // 3
+    ga, da = _act(gate_act), _act(cand_act)
+    h0 = jnp.zeros((B, H), x_tbh.dtype) if h0 is None else h0
+    if reverse:
+        x_tbh = x_tbh[::-1]
+        mask = mask[::-1]
+    w_ur = w_rec[:, : 2 * H]
+    w_c = w_rec[:, 2 * H :]
+
+    def step(h_prev, inp):
+        x_t, m_t = inp
+        if bias is not None:
+            x_t = x_t + bias
+        x_ur, x_c = x_t[:, : 2 * H], x_t[:, 2 * H :]
+        ur = ga(
+            x_ur
+            + jnp.dot(h_prev, w_ur, preferred_element_type=jnp.float32).astype(
+                x_t.dtype
+            )
+        )
+        u, r = ur[:, :H], ur[:, H:]
+        c = da(
+            x_c
+            + jnp.dot(r * h_prev, w_c, preferred_element_type=jnp.float32).astype(
+                x_t.dtype
+            )
+        )
+        # reference gru_finalOutput (operators/math/detail/gru_kernel.h:62):
+        # h = (1-u)*h_prev + u*c
+        h = (1 - u) * h_prev + u * c
+        m = m_t[:, None].astype(x_t.dtype)
+        h = m * h + (1 - m) * h_prev
+        return h, h
+
+    h_T, h_seq = jax.lax.scan(step, h0, (x_tbh, mask))
+    if reverse:
+        h_seq = h_seq[::-1]
+    return h_seq, h_T
+
+
+@register_op("dynamic_lstm")
+def dynamic_lstm_kernel(ctx):
+    """Reference: paddle/operators/lstm_op.cc / fluid layers nn.py:227.
+
+    Input is the pre-projected [*, 4H] LoDArray (the x @ W_x fc happens in
+    the preceding layer, matching the reference API)."""
+    x: LoDArray = ctx.input("Input")
+    w = ctx.input("Weight")  # [H, 4H]
+    b = ctx.input("Bias") if ctx.has_input("Bias") else None
+    use_peep = ctx.attr("use_peepholes", False)
+    peep = None
+    if b is not None and use_peep:
+        b, peep = b[: w.shape[1]], b[w.shape[1] :]
+    max_len = ctx.attr("max_len") or x.capacity
+    x_tb, mask = x.to_batch(max_len=max_len)
+    h_seq, (h_T, c_T) = lstm_scan(
+        x_tb,
+        mask,
+        w,
+        b,
+        w_peephole=peep,
+        gate_act=ctx.attr("gate_activation", "sigmoid"),
+        cell_act=ctx.attr("cell_activation", "tanh"),
+        cand_act=ctx.attr("candidate_activation", "tanh"),
+        reverse=ctx.attr("is_reverse", False),
+    )
+    ctx.set_output("Hidden", LoDArray.from_batch(h_seq, mask, x))
+    if ctx.has_output("LastH"):
+        ctx.set_output("LastH", h_T)
+    if ctx.has_output("LastC"):
+        ctx.set_output("LastC", c_T)
+
+
+@register_op("dynamic_gru")
+def dynamic_gru_kernel(ctx):
+    """Reference: paddle/operators/gru_op.cc / Gen-1 GatedRecurrentLayer."""
+    x: LoDArray = ctx.input("Input")
+    w = ctx.input("Weight")  # [H, 3H]
+    b = ctx.input("Bias") if ctx.has_input("Bias") else None
+    max_len = ctx.attr("max_len") or x.capacity
+    x_tb, mask = x.to_batch(max_len=max_len)
+    h_seq, h_T = gru_scan(
+        x_tb,
+        mask,
+        w,
+        b,
+        gate_act=ctx.attr("gate_activation", "sigmoid"),
+        cand_act=ctx.attr("candidate_activation", "tanh"),
+        reverse=ctx.attr("is_reverse", False),
+    )
+    ctx.set_output("Hidden", LoDArray.from_batch(h_seq, mask, x))
+    if ctx.has_output("LastH"):
+        ctx.set_output("LastH", h_T)
+
+
+@register_op("simple_rnn")
+def simple_rnn_kernel(ctx):
+    """Gen-1 RecurrentLayer.cpp: h_t = act(x_t + h_{t-1} @ W)."""
+    x: LoDArray = ctx.input("Input")
+    w = ctx.input("Weight")  # [H, H]
+    b = ctx.input("Bias") if ctx.has_input("Bias") else None
+    act = _act(ctx.attr("activation", "tanh"))
+    max_len = ctx.attr("max_len") or x.capacity
+    x_tb, mask = x.to_batch(max_len=max_len)
+
+    def step(h_prev, inp):
+        x_t, m_t = inp
+        h = x_t + jnp.dot(h_prev, w, preferred_element_type=jnp.float32).astype(
+            x_t.dtype
+        )
+        if b is not None:
+            h = h + b
+        h = act(h)
+        m = m_t[:, None].astype(x_t.dtype)
+        h = m * h + (1 - m) * h_prev
+        return h, h
+
+    B, H = x_tb.shape[1], w.shape[0]
+    h0 = jnp.zeros((B, H), x_tb.dtype)
+    h_T, h_seq = jax.lax.scan(step, h0, (x_tb, mask))
+    ctx.set_output("Hidden", LoDArray.from_batch(h_seq, mask, x))
+    if ctx.has_output("LastH"):
+        ctx.set_output("LastH", h_T)

@@ -1,0 +1,56 @@
+"""Book 08: understand_sentiment — stacked LSTM on IMDB (ragged, no padding).
+
+Reference acceptance test: python/paddle/v2/fluid/tests/book/
+test_understand_sentiment_lstm.py / ..._stacked_lstm.py — embedding →
+fc+lstm stack → pooled last states → softmax classifier, trained with Adam.
+"""
+
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu.data import batch, shuffle
+from paddle_tpu.data.datasets import imdb
+from paddle_tpu.data.feeder import DataFeeder
+
+
+def stacked_lstm_net(ids, vocab_size, emb_dim=32, hid_dim=32, stacked_num=2):
+    """Reference: fluid tests book stacked_lstm_net."""
+    emb = pt.layers.embedding(ids, size=[vocab_size, emb_dim])
+    fc1 = pt.layers.fc(emb, size=hid_dim * 4)
+    lstm1 = pt.layers.dynamic_lstm(fc1, size=hid_dim * 4, max_len=128)
+    inputs = [fc1, lstm1]
+    for _ in range(2, stacked_num + 1):
+        fc = pt.layers.fc(inputs, size=hid_dim * 4)
+        lstm = pt.layers.dynamic_lstm(fc, size=hid_dim * 4, is_reverse=False, max_len=128)
+        inputs = [fc, lstm]
+    fc_last = pt.layers.sequence_pool(inputs[0], "max")
+    lstm_last = pt.layers.sequence_pool(inputs[1], "max")
+    logits = pt.layers.fc([fc_last, lstm_last], size=2)
+    return logits
+
+
+def test_understand_sentiment_stacked_lstm():
+    ids = pt.layers.data("words", shape=[-1], dtype=np.int32, lod_level=1,
+                         append_batch_size=False)
+    label = pt.layers.data("label", shape=[1], dtype=np.int32)
+    logits = stacked_lstm_net(ids, vocab_size=5147)
+    cost = pt.layers.softmax_with_cross_entropy(logits, label)
+    avg_cost = pt.layers.mean(cost)
+    acc = pt.layers.accuracy(logits, label)
+    pt.optimizer.Adam(learning_rate=0.002).minimize(avg_cost)
+
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    feeder = DataFeeder([ids, label], bucket=2048, max_seqs=16)
+    reader = batch(shuffle(imdb.train(), 1000, seed=0), 16, drop_last=True)
+    accs = []
+    it = 0
+    while it < 50:
+        for data in reader():
+            feed = feeder.feed(data)
+            a, c = exe.run(feed=feed, fetch_list=[acc, avg_cost])
+            accs.append(float(a))
+            it += 1
+            if it >= 50:
+                break
+    assert np.mean(accs[-10:]) > 0.8, f"final acc {np.mean(accs[-10:])}"
