@@ -1,0 +1,81 @@
+"""Benchmark entry point: ResNet-50 training throughput on one TPU chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Baseline: the reference's best published ResNet-50 *training* number,
+81.69 images/sec on a 2-socket Xeon 6148 with MKL-DNN at batch 64
+(BASELINE.md / benchmark/IntelOptimizedPaddle.md:38-45 — the reference
+has no GPU ResNet number in-tree). vs_baseline = ours / 81.69.
+
+Env overrides: BENCH_BATCH (default 64), BENCH_STEPS (default 16).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def _build_resnet_train(batch):
+    import paddle_tpu as pt
+    from paddle_tpu import models
+
+    prog, startup = pt.Program(), pt.Program()
+    with pt.program_guard(prog, startup):
+        img = pt.layers.data("img", shape=[3, 224, 224])
+        label = pt.layers.data("label", shape=[1], dtype=np.int32)
+        logits = models.resnet_imagenet(img, class_dim=1000)
+        loss = pt.layers.mean(
+            pt.layers.softmax_with_cross_entropy(logits, label)
+        )
+        pt.optimizer.Momentum(learning_rate=0.1, momentum=0.9).minimize(loss)
+    rng = np.random.RandomState(0)
+    feed = {
+        "img": rng.randn(batch, 3, 224, 224).astype(np.float32),
+        "label": rng.randint(0, 1000, (batch, 1)).astype(np.int32),
+    }
+    return prog, startup, feed, loss
+
+
+def main():
+    batch = int(os.environ.get("BENCH_BATCH", 64))
+    steps = int(os.environ.get("BENCH_STEPS", 16))
+
+    import jax
+
+    import paddle_tpu as pt
+
+    prog, startup, feed, loss = _build_resnet_train(batch)
+    exe = pt.Executor()
+    exe.run(startup)
+
+    # warmup (compile + first steps)
+    for _ in range(3):
+        (l,) = exe.run(prog, feed=feed, fetch_list=[loss])
+    assert np.isfinite(l), f"non-finite loss {l}"
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        (l,) = exe.run(prog, feed=feed, fetch_list=[loss])
+    dt = time.perf_counter() - t0
+
+    images_per_sec = batch * steps / dt
+    baseline = 81.69  # ref ResNet-50 train img/s, MKL-DNN bs64 (BASELINE.md)
+    print(
+        json.dumps(
+            {
+                "metric": "resnet50_train_images_per_sec",
+                "value": round(images_per_sec, 2),
+                "unit": "images/sec",
+                "vs_baseline": round(images_per_sec / baseline, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    sys.exit(main())

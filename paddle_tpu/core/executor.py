@@ -135,6 +135,17 @@ class Executor:
         self.place = place or default_place()
         self._cache: Dict[Any, Any] = {}
 
+    # -- subclass hooks (ParallelExecutor overrides these) -------------
+    def _cache_key_prefix(self) -> tuple:
+        return ()
+
+    def _compile(self, program: Program, feed, fetch_names, persist_names):
+        """Build + wrap the traced block walk. Base: plain jax.jit."""
+        return self._build(program, sorted(feed), fetch_names, persist_names)
+
+    def _device_context(self):
+        return jax.default_device(self.place.device)
+
     # ------------------------------------------------------------------
     def run(
         self,
@@ -161,7 +172,7 @@ class Executor:
             for v in program.persistables()
             if scope.has(v.name)
         )
-        key = (
+        key = self._cache_key_prefix() + (
             id(program),
             program.version,
             _feed_signature(feed),
@@ -170,7 +181,7 @@ class Executor:
         )
         cached = self._cache.get(key)
         if cached is None:
-            fn = self._build(program, sorted(feed), fetch_names, persist_names)
+            fn = self._compile(program, feed, fetch_names, persist_names)
             # keep a strong ref to the program: the key uses id(program),
             # which may be recycled if the program were garbage collected
             self._cache[key] = (program, fn)
@@ -183,7 +194,7 @@ class Executor:
             else program.random_seed,
             dtype=jnp.uint32,
         )
-        with jax.default_device(self.place.device):
+        with self._device_context():
             fetches, new_state = fn(state, feed, seed)
         for n, v in new_state.items():
             scope.set(n, v)

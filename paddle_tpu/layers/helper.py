@@ -49,12 +49,18 @@ class LayerHelper:
         init = attr.initializer or default_initializer or (
             ConstantInitializer(0.0) if is_bias else XavierInitializer()
         )
-        param = self.main_program.global_block().create_parameter(
+        gb = self.main_program.global_block()
+        existed = name in gb.vars
+        param = gb.create_parameter(
             name,
             tuple(shape),
             dtype,
             trainable=attr.trainable,
         )
+        if existed:
+            # shared parameter (e.g. tied embeddings): created once,
+            # initialized once — don't append duplicate init ops
+            return param
         param.regularizer = attr.regularizer
         param.grad_clip = attr.gradient_clip
         param.optimize_attr = {"learning_rate": attr.learning_rate}

@@ -476,7 +476,13 @@ def cast(x, dtype):
 
 def concat(input, axis=0):
     helper = LayerHelper("concat")
-    out = helper.create_tmp_variable(input[0].dtype, input[0].shape)
+    shape = list(input[0].shape)
+    ax = axis if axis >= 0 else len(shape) + axis
+    if all(v.shape[ax] != -1 for v in input):
+        shape[ax] = sum(v.shape[ax] for v in input)
+    else:
+        shape[ax] = -1
+    out = helper.create_tmp_variable(input[0].dtype, tuple(shape))
     helper.append_op(
         type="concat", inputs={"X": list(input)}, outputs={"Out": [out]},
         attrs={"axis": axis},

@@ -1,0 +1,12 @@
+"""Model zoo (reference: benchmark/paddle configs + book models)."""
+
+from .image import (  # noqa: F401
+    alexnet,
+    googlenet,
+    lenet,
+    resnet_cifar10,
+    resnet_imagenet,
+    smallnet,
+    vgg,
+)
+from .text import lstm_benchmark_net, stacked_lstm_net, word2vec_net  # noqa: F401

@@ -1,0 +1,179 @@
+"""Image-classification model zoo.
+
+Reference configs (behavioral parity, re-written for the TPU layer DSL):
+benchmark/paddle/image/{resnet,vgg,alexnet,googlenet,smallnet_mnist_cifar}.py
+and the book image_classification nets (python/paddle/v2/fluid/tests/book/
+test_image_classification_train.py). All take an NCHW image Variable and
+return logits; callers attach loss/optimizer.
+"""
+
+from __future__ import annotations
+
+import paddle_tpu.layers as layers
+
+
+# ----------------------------------------------------------------- ResNet --
+def conv_bn_layer(input, num_filters, filter_size, stride=1, padding=None,
+                  act="relu", is_test=False):
+    if padding is None:
+        padding = (filter_size - 1) // 2
+    conv = layers.conv2d(
+        input, num_filters=num_filters, filter_size=filter_size,
+        stride=stride, padding=padding, bias_attr=False,
+    )
+    return layers.batch_norm(conv, act=act, is_test=is_test)
+
+
+def _shortcut(input, ch_out, stride, is_test):
+    ch_in = input.shape[1]
+    if ch_in != ch_out or stride != 1:
+        return conv_bn_layer(input, ch_out, 1, stride, 0, act=None, is_test=is_test)
+    return input
+
+
+def _bottleneck(input, ch_out, stride, is_test):
+    short = _shortcut(input, ch_out * 4, stride, is_test)
+    conv1 = conv_bn_layer(input, ch_out, 1, 1, 0, is_test=is_test)
+    conv2 = conv_bn_layer(conv1, ch_out, 3, stride, 1, is_test=is_test)
+    conv3 = conv_bn_layer(conv2, ch_out * 4, 1, 1, 0, act=None, is_test=is_test)
+    return layers.relu(layers.elementwise_add(conv3, short))
+
+
+def _basicblock(input, ch_out, stride, is_test):
+    short = _shortcut(input, ch_out, stride, is_test)
+    conv1 = conv_bn_layer(input, ch_out, 3, stride, 1, is_test=is_test)
+    conv2 = conv_bn_layer(conv1, ch_out, 3, 1, 1, act=None, is_test=is_test)
+    return layers.relu(layers.elementwise_add(conv2, short))
+
+
+def resnet_imagenet(input, class_dim=1000, depth=50, is_test=False):
+    """ResNet-50/101/152 (reference: benchmark/paddle/image/resnet.py
+
+    layout; bottleneck counts per the standard table)."""
+    cfg = {50: [3, 4, 6, 3], 101: [3, 4, 23, 3], 152: [3, 8, 36, 3]}[depth]
+    conv = conv_bn_layer(input, 64, 7, 2, 3, is_test=is_test)
+    pool = layers.pool2d(conv, pool_size=3, pool_stride=2, pool_padding=1)
+    ch = [64, 128, 256, 512]
+    for stage, count in enumerate(cfg):
+        for i in range(count):
+            stride = 2 if i == 0 and stage > 0 else 1
+            pool = _bottleneck(pool, ch[stage], stride, is_test)
+    pool = layers.pool2d(pool, pool_type="avg", global_pooling=True)
+    return layers.fc(pool, size=class_dim)
+
+
+def resnet_cifar10(input, class_dim=10, depth=32, is_test=False):
+    """Reference: book image_classification resnet_cifar10."""
+    assert (depth - 2) % 6 == 0
+    n = (depth - 2) // 6
+    conv = conv_bn_layer(input, 16, 3, 1, 1, is_test=is_test)
+    for stage, ch in enumerate([16, 32, 64]):
+        for i in range(n):
+            stride = 2 if i == 0 and stage > 0 else 1
+            conv = _basicblock(conv, ch, stride, is_test)
+    pool = layers.pool2d(conv, pool_type="avg", global_pooling=True)
+    return layers.fc(pool, size=class_dim)
+
+
+# -------------------------------------------------------------------- VGG --
+def vgg(input, class_dim=1000, depth=16, is_test=False):
+    """VGG-16/19 with BN (reference: benchmark/paddle/image/vgg.py)."""
+    cfg = {
+        16: [2, 2, 3, 3, 3],
+        19: [2, 2, 4, 4, 4],
+    }[depth]
+    channels = [64, 128, 256, 512, 512]
+    tmp = input
+    for block, convs in enumerate(cfg):
+        for _ in range(convs):
+            tmp = conv_bn_layer(tmp, channels[block], 3, 1, 1, is_test=is_test)
+        tmp = layers.pool2d(tmp, pool_size=2, pool_stride=2)
+    tmp = layers.fc(tmp, size=4096, act="relu")
+    tmp = layers.dropout(tmp, 0.5, is_test=is_test)
+    tmp = layers.fc(tmp, size=4096, act="relu")
+    tmp = layers.dropout(tmp, 0.5, is_test=is_test)
+    return layers.fc(tmp, size=class_dim)
+
+
+# ---------------------------------------------------------------- AlexNet --
+def alexnet(input, class_dim=1000, is_test=False):
+    """Reference: benchmark/paddle/image/alexnet.py (conv-lrn-pool x2,
+
+    3 convs, 2 fc4096 + dropout)."""
+    t = layers.conv2d(input, 64, 11, stride=4, padding=2, act="relu")
+    t = layers.lrn(t)
+    t = layers.pool2d(t, pool_size=3, pool_stride=2)
+    t = layers.conv2d(t, 192, 5, padding=2, act="relu")
+    t = layers.lrn(t)
+    t = layers.pool2d(t, pool_size=3, pool_stride=2)
+    t = layers.conv2d(t, 384, 3, padding=1, act="relu")
+    t = layers.conv2d(t, 256, 3, padding=1, act="relu")
+    t = layers.conv2d(t, 256, 3, padding=1, act="relu")
+    t = layers.pool2d(t, pool_size=3, pool_stride=2)
+    t = layers.fc(t, size=4096, act="relu")
+    t = layers.dropout(t, 0.5, is_test=is_test)
+    t = layers.fc(t, size=4096, act="relu")
+    t = layers.dropout(t, 0.5, is_test=is_test)
+    return layers.fc(t, size=class_dim)
+
+
+# -------------------------------------------------------------- GoogLeNet --
+def _inception(input, c1, c3r, c3, c5r, c5, proj):
+    b1 = layers.conv2d(input, c1, 1, act="relu")
+    b3 = layers.conv2d(input, c3r, 1, act="relu")
+    b3 = layers.conv2d(b3, c3, 3, padding=1, act="relu")
+    b5 = layers.conv2d(input, c5r, 1, act="relu")
+    b5 = layers.conv2d(b5, c5, 5, padding=2, act="relu")
+    bp = layers.pool2d(input, pool_size=3, pool_stride=1, pool_padding=1)
+    bp = layers.conv2d(bp, proj, 1, act="relu")
+    return layers.concat([b1, b3, b5, bp], axis=1)
+
+
+def googlenet(input, class_dim=1000, is_test=False):
+    """Reference: benchmark/paddle/image/googlenet.py (Inception v1; the
+
+    two aux heads are omitted — they only affect training regularization)."""
+    t = layers.conv2d(input, 64, 7, stride=2, padding=3, act="relu")
+    t = layers.pool2d(t, pool_size=3, pool_stride=2, pool_padding=1)
+    t = layers.conv2d(t, 64, 1, act="relu")
+    t = layers.conv2d(t, 192, 3, padding=1, act="relu")
+    t = layers.pool2d(t, pool_size=3, pool_stride=2, pool_padding=1)
+    t = _inception(t, 64, 96, 128, 16, 32, 32)
+    t = _inception(t, 128, 128, 192, 32, 96, 64)
+    t = layers.pool2d(t, pool_size=3, pool_stride=2, pool_padding=1)
+    t = _inception(t, 192, 96, 208, 16, 48, 64)
+    t = _inception(t, 160, 112, 224, 24, 64, 64)
+    t = _inception(t, 128, 128, 256, 24, 64, 64)
+    t = _inception(t, 112, 144, 288, 32, 64, 64)
+    t = _inception(t, 256, 160, 320, 32, 128, 128)
+    t = layers.pool2d(t, pool_size=3, pool_stride=2, pool_padding=1)
+    t = _inception(t, 256, 160, 320, 32, 128, 128)
+    t = _inception(t, 384, 192, 384, 48, 128, 128)
+    t = layers.pool2d(t, pool_type="avg", global_pooling=True)
+    t = layers.dropout(t, 0.4, is_test=is_test)
+    return layers.fc(t, size=class_dim)
+
+
+# ----------------------------------------------------- SmallNet (CIFAR) ---
+def smallnet(input, class_dim=10, is_test=False):
+    """Reference: benchmark/paddle/image/smallnet_mnist_cifar.py — the
+
+    caffe 'cifar10_quick' net."""
+    t = layers.conv2d(input, 32, 5, padding=2, act="relu")
+    t = layers.pool2d(t, pool_size=3, pool_stride=2)
+    t = layers.conv2d(t, 32, 5, padding=2, act="relu")
+    t = layers.pool2d(t, pool_size=3, pool_stride=2, pool_type="avg")
+    t = layers.conv2d(t, 64, 5, padding=2, act="relu")
+    t = layers.pool2d(t, pool_size=3, pool_stride=2, pool_type="avg")
+    t = layers.fc(t, size=64, act="relu")
+    return layers.fc(t, size=class_dim)
+
+
+# ------------------------------------------------------------------ LeNet --
+def lenet(input, class_dim=10, is_test=False):
+    """Reference: book recognize_digits conv net (nets.simple_img_conv_pool)."""
+    t = layers.conv2d(input, 20, 5, act="relu")
+    t = layers.pool2d(t, pool_size=2, pool_stride=2)
+    t = layers.conv2d(t, 50, 5, act="relu")
+    t = layers.pool2d(t, pool_size=2, pool_stride=2)
+    return layers.fc(t, size=class_dim)

@@ -1,0 +1,27 @@
+"""Parallelism & communication (SURVEY.md §2.3 / §5.8).
+
+The reference's entire distribution stack — MultiGradientMachine ring
+allreduce, C++/Go parameter servers, DistributeTranspiler, NCCL ops, gRPC
+send/recv, etcd membership — collapses into sharding annotations over a
+jax.sharding.Mesh plus XLA collectives on ICI/DCN. See data_parallel.py
+for the mapping table.
+"""
+
+from .collective import (  # noqa: F401
+    all_gather,
+    all_reduce,
+    broadcast,
+    ppermute_ring,
+    reduce_scatter,
+    ring_all_reduce,
+    shard_map_fn,
+)
+from .data_parallel import ParallelExecutor  # noqa: F401
+from .distributed import (  # noqa: F401
+    init_distributed,
+    is_chief,
+    process_count,
+    process_index,
+)
+from .mesh import DP, MP, PP, SP, batch_sharded, dim_sharded, make_mesh, replicated  # noqa: F401
+from .sharded_embedding import sharded_embedding  # noqa: F401

@@ -1,0 +1,79 @@
+"""Collective communication primitives (explicit shard_map level).
+
+Reference backends being replaced (SURVEY.md §5.8): NCCL ops
+(operators/nccl_op.cc:216-223 — init/allreduce/bcast/reduce), the Gen-1
+software ring allreduce between GPU threads (MultiGradientMachine.h:63-110),
+gRPC tensor send/recv (operators/detail/grpc_client.cc), and the
+TCP/RDMA pserver transport (pserver/LightNetwork.h:40).
+
+Most code should NOT call these: pjit/GSPMD inserts collectives from
+sharding annotations (data_parallel.py). These wrappers exist for the
+shard_map escape hatch — custom schedules (ring attention,
+reduce-scatter'd optimizers) where you want manual control over what
+rides the ICI.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec
+
+
+def all_reduce(x, axis_name: str):
+    """NCCL allreduce parity (nccl_op.cc ncclAllReduce) → lax.psum."""
+    return jax.lax.psum(x, axis_name)
+
+
+def all_gather(x, axis_name: str, axis: int = 0, tiled: bool = True):
+    return jax.lax.all_gather(x, axis_name, axis=axis, tiled=tiled)
+
+
+def reduce_scatter(x, axis_name: str, axis: int = 0):
+    return jax.lax.psum_scatter(x, axis_name, scatter_dimension=axis, tiled=True)
+
+
+def broadcast(x, axis_name: str, root: int = 0):
+    """NCCL bcast parity: every shard takes root's value."""
+    full = jax.lax.all_gather(x, axis_name, axis=0, tiled=False)
+    return full[root]
+
+
+def ppermute_ring(x, axis_name: str, shift: int = 1):
+    """Neighbor exchange on the ring — building block for ring attention
+
+    and the hand-rolled ring allreduce below."""
+    n = jax.lax.axis_size(axis_name)
+    perm = [(i, (i + shift) % n) for i in range(n)]
+    return jax.lax.ppermute(x, axis_name, perm)
+
+
+def ring_all_reduce(x, axis_name: str):
+    """Educational parity with MultiGradientMachine's software ring
+
+    (MultiGradientMachine.h:63-110): reduce-scatter + all-gather by
+    neighbor exchange. On TPU, prefer lax.psum — XLA's allreduce is
+    already ring-scheduled on ICI; this exists for tests/benchmarks."""
+    n = jax.lax.axis_size(axis_name)
+    if n == 1:
+        return x
+    idx = jax.lax.axis_index(axis_name)
+    chunks = jnp.stack(jnp.split(x.reshape(-1), n))
+    # reduce-scatter phase
+    acc = chunks[idx]
+    buf = chunks
+    for step in range(1, n):
+        buf = ppermute_ring(buf, axis_name, shift=1)
+        acc = acc + buf[idx]
+    # all-gather phase
+    out = jnp.zeros_like(chunks).at[idx].set(acc)
+    gathered = jax.lax.psum(out, axis_name)  # combine owned chunks
+    return gathered.reshape(x.shape)
+
+
+def shard_map_fn(fn, mesh: Mesh, in_specs, out_specs):
+    """Thin wrapper over jax.shard_map bound to a mesh."""
+    return jax.shard_map(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs)

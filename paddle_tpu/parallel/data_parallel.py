@@ -1,0 +1,106 @@
+"""Data-parallel (and model-parallel-annotated) program execution.
+
+This is the TPU collapse of three reference subsystems (SURVEY.md §2.3):
+
+- MultiGradientMachine's per-GPU trainer threads with ring gradient
+  scatter/gather (gserver/gradientmachines/MultiGradientMachine.h:63-110)
+- the C++ parameter server path: RemoteParameterUpdater →
+  ParameterClient2.sendAndReceiveParameter → ParameterServer2 block-sharded
+  SGD (pserver/ParameterServer2.cpp:682,908)
+- the Fluid DistributeTranspiler program rewrite into send/recv + pserver
+  subprograms (python/paddle/v2/fluid/distribute_transpiler.py:77)
+
+All three exist to do one thing: sum gradients across replicas and apply
+the update once. Under GSPMD that entire machinery is *one sharding
+annotation*: feeds are sharded over the `dp` mesh axis, parameters are
+replicated (or sharded over `mp` for large embeddings — the reference's
+"sparse parameters live on pservers" large-model mode), and XLA inserts
+the psum/all_gather collectives over ICI. Async-SGD (ParameterServer2.cpp
+:457) is intentionally dropped: on a dedicated synchronous fabric, sync
+SGD strictly dominates — documented behavioral difference.
+
+ParallelExecutor runs the SAME Program as core.Executor — parallelism is
+a deployment property, not a model property, which is the design insight
+the reference's transpiler approximated by rewriting programs.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from ..core.executor import Executor
+from ..core.lod import LoDArray
+from ..core.program import Program
+from .mesh import DP, make_mesh
+
+
+class ParallelExecutor(Executor):
+    """Executor with a Mesh: feeds sharded over `dp`, params replicated
+
+    unless a Variable carries `.sharding` (a PartitionSpec) — e.g. a vocab-
+    sharded embedding table (parallel/sharded_embedding.py)."""
+
+    def __init__(self, mesh: Optional[Mesh] = None, batch_axis: str = DP):
+        super().__init__()
+        self.mesh = mesh or make_mesh()
+        self.batch_axis = batch_axis
+
+    # -- sharding rules -----------------------------------------------------
+    def _state_sharding(self, program: Program, name: str) -> NamedSharding:
+        gb = program.global_block()
+        if name in gb.vars:
+            spec = getattr(gb.vars[name], "sharding", None)
+            if spec is not None:
+                return NamedSharding(self.mesh, spec)
+        return NamedSharding(self.mesh, PartitionSpec())
+
+    def _feed_sharding(self, value) -> Any:
+        def shard_leaf(leaf):
+            if leaf.ndim == 0:
+                return NamedSharding(self.mesh, PartitionSpec())
+            return NamedSharding(
+                self.mesh,
+                PartitionSpec(self.batch_axis, *([None] * (leaf.ndim - 1))),
+            )
+
+        if isinstance(value, LoDArray):
+            # ragged feeds: shard the flat token axis and the seq axis.
+            # Sequences may straddle shard boundaries; segment reductions
+            # then ride ICI collectives (correct, and cheap vs the scan).
+            return LoDArray(
+                shard_leaf(value.data),
+                shard_leaf(value.seq_ids),
+                shard_leaf(value.lengths),
+                NamedSharding(self.mesh, PartitionSpec()),
+                None if value.sub_seq_ids is None else shard_leaf(value.sub_seq_ids),
+            )
+        return shard_leaf(value)
+
+    # -- Executor hooks -----------------------------------------------------
+    def _cache_key_prefix(self) -> tuple:
+        return ("par", id(self.mesh))
+
+    def _device_context(self):
+        return self.mesh
+
+    def _compile(self, program: Program, feed, fetch_names, persist_names):
+        base = Executor._build(
+            self, program, sorted(feed), fetch_names, persist_names
+        )
+        raw = base.__wrapped__  # the untraced block-walk callable
+        state_shardings = {
+            n: self._state_sharding(program, n) for n in persist_names
+        }
+        feed_shardings = {k: self._feed_sharding(v) for k, v in feed.items()}
+        return jax.jit(
+            raw,
+            in_shardings=(
+                state_shardings,
+                feed_shardings,
+                NamedSharding(self.mesh, PartitionSpec()),
+            ),
+            out_shardings=(None, state_shardings),
+        )

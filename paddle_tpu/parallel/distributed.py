@@ -1,0 +1,61 @@
+"""Multi-host membership and initialization.
+
+Reference: etcd-based discovery and barriers — go/pserver/etcd_client.go:
+31-41 (register with desired count, wait until all present), go/master/
+etcd_client.go (leader election, state snapshots), plus the static
+trainer_id/num_gradient_servers gflags world (utils/Flags.cpp).
+
+TPU-native: jax.distributed.initialize() — the JAX coordinator service
+fills the etcd role (rendezvous, process ids, health), and DCN collectives
+connect the hosts. Membership is static per job (the scheduler restarts
+the whole job on failure; checkpoint/resume covers recovery — see
+trainer/checkpoint.py)."""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+
+
+_initialized = False
+
+
+def init_distributed(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> None:
+    """Initialize multi-host JAX. No-op when single-host or already done.
+
+    Args default from env (COORDINATOR_ADDRESS, NUM_PROCESSES, PROCESS_ID)
+    the way the reference's trainer read trainer_id/pservers gflags."""
+    global _initialized
+    if _initialized:
+        return
+    coordinator_address = coordinator_address or os.environ.get("COORDINATOR_ADDRESS")
+    if coordinator_address is None:
+        _initialized = True  # single host
+        return
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=int(num_processes or os.environ.get("NUM_PROCESSES", 1)),
+        process_id=int(process_id or os.environ.get("PROCESS_ID", 0)),
+    )
+    _initialized = True
+
+
+def process_count() -> int:
+    return jax.process_count()
+
+
+def process_index() -> int:
+    return jax.process_index()
+
+
+def is_chief() -> bool:
+    """The reference elected a model-saving trainer (go/master/service.go:481
+
+    RequestSaveModel); here process 0 is the chief."""
+    return jax.process_index() == 0

@@ -1,0 +1,53 @@
+"""Device mesh construction and axis conventions.
+
+Replaces the reference's device topology plumbing: trainer_count GPU
+threads (gserver/gradientmachines/MultiGradientMachine.h:168), pserver
+shard maps (pserver/ParameterServer2.h:74-90), and etcd membership
+(go/pserver/etcd_client.go). On TPU the topology is a jax.sharding.Mesh
+over ICI; axis names are the vocabulary the rest of the framework uses:
+
+  dp — data parallel (batch)            ≙ trainer_count / num trainers
+  mp — model parallel (sharded params)  ≙ pserver parameter blocks
+  sp — sequence parallel (long context) — seam, see parallel/context.py
+  pp — pipeline stages                  ≙ ParallelNeuralNetwork device attr
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+DP, MP, SP, PP = "dp", "mp", "sp", "pp"
+
+
+def make_mesh(
+    shape: Optional[Sequence[int]] = None,
+    axis_names: Sequence[str] = (DP,),
+    devices=None,
+) -> Mesh:
+    """Build a Mesh. Default: all local devices on one `dp` axis."""
+    devices = list(devices if devices is not None else jax.devices())
+    if shape is None:
+        shape = (len(devices),)
+    if int(np.prod(shape)) != len(devices):
+        raise ValueError(f"mesh shape {shape} != {len(devices)} devices")
+    arr = np.asarray(devices).reshape(shape)
+    return Mesh(arr, tuple(axis_names))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, PartitionSpec())
+
+
+def batch_sharded(mesh: Mesh, axis: str = DP, ndim: int = 2) -> NamedSharding:
+    """Shard dim 0 (batch) over `axis`, replicate the rest."""
+    return NamedSharding(mesh, PartitionSpec(axis, *([None] * (ndim - 1))))
+
+
+def dim_sharded(mesh: Mesh, dim: int, axis: str, ndim: int) -> NamedSharding:
+    spec = [None] * ndim
+    spec[dim] = axis
+    return NamedSharding(mesh, PartitionSpec(*spec))

@@ -1,0 +1,57 @@
+"""Vocab-sharded embedding tables (large-model / sparse parity).
+
+Reference: the reference keeps huge embedding tables OFF the trainers —
+sparse-row parameters live only on pservers, trainers prefetch the rows a
+batch needs and push sparse grads back (math/SparseRowMatrix.h:31,206,237;
+trainer/RemoteParameterUpdater.h:265 SparseRemoteParameterUpdater;
+GradientMachine.h:69 prefetch; doc/design/cluster_train/
+large_model_dist_train.md).
+
+TPU-native: shard the table over the `mp` mesh axis (rows striped across
+chips) and let XLA turn jnp.take into a sharded gather — the "prefetch"
+becomes an all-to-all over ICI, and the sparse gradient push becomes the
+scatter-add XLA emits for the gather's transpose, landing only on the
+owning shard. One annotation replaces the entire sparse-pserver protocol.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+from jax.sharding import PartitionSpec
+
+from ..initializer import NormalInitializer
+from ..layers.helper import LayerHelper
+from .mesh import MP
+
+
+def sharded_embedding(
+    input,
+    size,
+    mesh_axis: str = MP,
+    padding_idx: Optional[int] = None,
+    param_attr=None,
+    dtype=np.float32,
+    name=None,
+):
+    """Like layers.embedding but the table is sharded over `mesh_axis`.
+
+    Use with ParallelExecutor over a mesh that has that axis."""
+    helper = LayerHelper("sharded_embedding", name=name)
+    w = helper.create_parameter(
+        param_attr,
+        shape=tuple(size),
+        dtype=dtype,
+        default_initializer=NormalInitializer(0.0, 0.01),
+    )
+    w.sharding = PartitionSpec(mesh_axis, None)  # rows striped across chips
+    out = helper.create_tmp_variable(dtype, tuple(input.shape) + (size[1],),
+                                     input.lod_level)
+    helper.append_op(
+        type="lookup_table",
+        inputs={"W": [w], "Ids": [input]},
+        outputs={"Out": [out]},
+        attrs={"is_sparse": True, "padding_idx": padding_idx},
+    )
+    return out

@@ -1,0 +1,110 @@
+"""Model-zoo smoke tests: build + forward + one training step, finite loss.
+
+Reference analogue: benchmark config parse tests and
+gserver/tests/test_NetworkCompare.cpp (nets build and run). Spatial dims
+are shrunk (96x96) to keep the 1-core CPU suite fast; architecture code
+paths are identical.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import models
+from paddle_tpu.core.lod import LoDArray
+
+
+@pytest.mark.parametrize(
+    "net,hw",
+    [
+        (models.alexnet, 96),
+        (models.vgg, 96),
+        (models.googlenet, 96),
+        (models.resnet_imagenet, 96),
+    ],
+    ids=["alexnet", "vgg16", "googlenet", "resnet50"],
+)
+def test_imagenet_models_one_step(net, hw):
+    img = pt.layers.data("img", shape=[3, hw, hw])
+    label = pt.layers.data("label", shape=[1], dtype=np.int32)
+    logits = net(img, class_dim=10)
+    loss = pt.layers.mean(pt.layers.softmax_with_cross_entropy(logits, label))
+    pt.optimizer.SGD(learning_rate=0.01).minimize(loss)
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    rng = np.random.RandomState(0)
+    xv = rng.randn(2, 3, hw, hw).astype(np.float32)
+    yv = rng.randint(0, 10, (2, 1)).astype(np.int32)
+    (l,) = exe.run(feed={"img": xv, "label": yv}, fetch_list=[loss])
+    assert np.isfinite(l), l
+
+
+@pytest.mark.parametrize("net", [models.smallnet, models.lenet,
+                                 models.resnet_cifar10],
+                         ids=["smallnet", "lenet", "resnet32_cifar"])
+def test_small_models_one_step(net):
+    img = pt.layers.data("img", shape=[3, 32, 32])
+    label = pt.layers.data("label", shape=[1], dtype=np.int32)
+    logits = net(img, class_dim=10)
+    loss = pt.layers.mean(pt.layers.softmax_with_cross_entropy(logits, label))
+    pt.optimizer.Momentum(learning_rate=0.01).minimize(loss)
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    rng = np.random.RandomState(0)
+    xv = rng.randn(4, 3, 32, 32).astype(np.float32)
+    yv = rng.randint(0, 10, (4, 1)).astype(np.int32)
+    (l,) = exe.run(feed={"img": xv, "label": yv}, fetch_list=[loss])
+    assert np.isfinite(l), l
+
+
+def test_lstm_benchmark_net_one_step():
+    words = pt.layers.data("words", shape=[-1], dtype=np.int32, lod_level=1,
+                           append_batch_size=False)
+    label = pt.layers.data("label", shape=[1], dtype=np.int32)
+    logits = models.lstm_benchmark_net(words, vocab_size=1000, emb_dim=16,
+                                       hidden=16, max_len=16)
+    loss = pt.layers.mean(pt.layers.softmax_with_cross_entropy(logits, label))
+    pt.optimizer.Adam(0.002).minimize(loss)
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    rng = np.random.RandomState(0)
+    seqs = [rng.randint(0, 1000, (int(rng.randint(3, 16)),)).astype(np.int32)
+            for _ in range(4)]
+    lod = LoDArray.from_sequences(seqs, capacity=64, max_seqs=4)
+    yv = rng.randint(0, 2, (4, 1)).astype(np.int32)
+    (l,) = exe.run(feed={"words": lod, "label": yv}, fetch_list=[loss])
+    assert np.isfinite(l), l
+
+
+def test_stacked_lstm_net_one_step():
+    words = pt.layers.data("words", shape=[-1], dtype=np.int32, lod_level=1,
+                           append_batch_size=False)
+    label = pt.layers.data("label", shape=[1], dtype=np.int32)
+    logits = models.stacked_lstm_net(words, vocab_size=500, emb_dim=8,
+                                     hid_dim=8, stacked_num=3, max_len=16)
+    loss = pt.layers.mean(pt.layers.softmax_with_cross_entropy(logits, label))
+    pt.optimizer.Adam(0.002).minimize(loss)
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    rng = np.random.RandomState(0)
+    seqs = [rng.randint(0, 500, (int(rng.randint(3, 16)),)).astype(np.int32)
+            for _ in range(4)]
+    lod = LoDArray.from_sequences(seqs, capacity=64, max_seqs=4)
+    yv = rng.randint(0, 2, (4, 1)).astype(np.int32)
+    (l,) = exe.run(feed={"words": lod, "label": yv}, fetch_list=[loss])
+    assert np.isfinite(l), l
+
+
+def test_word2vec_net_one_step():
+    ws = [pt.layers.data(f"w{i}", shape=[1], dtype=np.int32) for i in range(4)]
+    nxt = pt.layers.data("next", shape=[1], dtype=np.int32)
+    logits = models.word2vec_net(ws, dict_size=100, emb_dim=8)
+    loss = pt.layers.mean(pt.layers.softmax_with_cross_entropy(logits, nxt))
+    pt.optimizer.SGD(0.1).minimize(loss)
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    rng = np.random.RandomState(0)
+    feed = {f"w{i}": rng.randint(0, 100, (8, 1)).astype(np.int32) for i in range(4)}
+    feed["next"] = rng.randint(0, 100, (8, 1)).astype(np.int32)
+    (l,) = exe.run(feed=feed, fetch_list=[loss])
+    assert np.isfinite(l)
