@@ -22,6 +22,7 @@ Quick start (fit_a_line, reference book/01)::
 from . import core  # noqa: F401
 from . import ops  # noqa: F401  (registers all kernels)
 from . import initializer  # noqa: F401
+from . import io  # noqa: F401
 from . import layers  # noqa: F401
 from . import optimizer  # noqa: F401
 from . import regularizer  # noqa: F401
@@ -40,7 +41,16 @@ from .core import (  # noqa: F401
     reset_default_programs,
     reset_global_scope,
 )
+from .gradient_checker import check_gradient  # noqa: F401
 from .param_attr import ParamAttr  # noqa: F401
+from .trainer import (  # noqa: F401
+    BeginIteration,
+    BeginPass,
+    CheckpointConfig,
+    EndIteration,
+    EndPass,
+    Trainer,
+)
 from .version import full_version as __version__  # noqa: F401
 
 

@@ -210,8 +210,22 @@ class Program:
     def persistables(self) -> List[Variable]:
         return [v for v in self.global_block().vars.values() if v.persistable]
 
-    def clone(self) -> "Program":
-        return copy.deepcopy(self)
+    def clone(self, for_test: bool = False) -> "Program":
+        """Deep copy; for_test=True additionally drops the backward+optimizer
+        slice and flips is_test attrs (fluid framework.py Program.clone)."""
+        p = copy.deepcopy(self)
+        if for_test:
+            for b in p.blocks:
+                b.ops = [
+                    op
+                    for op in b.ops
+                    if op.type != "autodiff" and not op.attrs.get("is_optimizer_op")
+                ]
+                for op in b.ops:
+                    if "is_test" in op.attrs:
+                        op.attrs["is_test"] = True
+            p.bump_version()
+        return p
 
     # -- serialization (model_format parity) --------------------------------
     def to_dict(self) -> dict:

@@ -1,0 +1,294 @@
+"""Model / checkpoint IO.
+
+Reference surface being rebuilt:
+- fluid/io.py: save_vars / save_params / save_persistables, save_inference_model
+  (prunes the program to the feed→fetch slice and serializes ProgramDesc +
+  params; model format doc doc/design/model_format.md), load_* counterparts.
+- Gen-1 ParamUtil (paddle/trainer/ParamUtil.h:58-93): per-pass checkpoint dirs
+  with cadence flags, resume via init_model_path/start_pass.
+- v2 Parameters.to_tar/from_tar (python/paddle/v2/parameters.py:328,358).
+- framework/prune.cc: dataflow-slice of a ProgramDesc.
+
+TPU design: the Scope already holds every persistable value (parameters,
+optimizer accumulators, BN statistics, LR/step counters) as host-transferable
+arrays, so a checkpoint is one `.npz` of the persistable slice of the Scope
+plus a JSON sidecar (program + metadata). Sharded arrays come back to host
+via np.asarray (an all-gather under jit-less access), which matches orbax's
+restore-to-host semantics at the scale this framework targets; the format is
+deliberately single-file so a checkpoint is also the deployment artifact
+(MergeModel.cpp parity).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import shutil
+import tempfile
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from .core.executor import Executor, Scope, global_scope
+from .core.lod import LoDArray
+from .core.program import Program, Variable, default_main_program
+
+__all__ = [
+    "save_vars",
+    "save_params",
+    "save_persistables",
+    "load_vars",
+    "load_params",
+    "load_persistables",
+    "save_inference_model",
+    "load_inference_model",
+    "save_checkpoint",
+    "load_checkpoint",
+    "clean_checkpoint",
+    "get_latest_checkpoint_serial",
+]
+
+PARAMS_FILE = "params.npz"
+PROGRAM_FILE = "program.json"
+META_FILE = "meta.json"
+CHECKPOINT_PREFIX = "checkpoint"
+
+
+# ---------------------------------------------------------------------------
+# variable-level save/load (fluid io.py save_vars/load_vars)
+# ---------------------------------------------------------------------------
+
+def _to_host(value) -> np.ndarray:
+    if isinstance(value, LoDArray):
+        raise TypeError("cannot checkpoint a LoDArray variable")
+    return np.asarray(value)
+
+
+def save_vars(
+    dirname: str,
+    var_names: Sequence[str],
+    scope: Optional[Scope] = None,
+    filename: str = PARAMS_FILE,
+) -> str:
+    """Save named scope values as one npz under `dirname`. Atomic (tmp+rename)
+    so a preempted save never corrupts the previous checkpoint
+    (go/pserver checkpoint design parity, service.go:346)."""
+    scope = scope or global_scope()
+    os.makedirs(dirname, exist_ok=True)
+    arrays = {n: _to_host(scope.get(n)) for n in var_names}
+    path = os.path.join(dirname, filename)
+    fd, tmp = tempfile.mkstemp(dir=dirname, suffix=".tmp")
+    os.close(fd)
+    try:
+        with open(tmp, "wb") as f:
+            np.savez(f, **arrays)
+        os.replace(tmp, path)
+    finally:
+        if os.path.exists(tmp):
+            os.remove(tmp)
+    return path
+
+
+def load_vars(
+    dirname: str,
+    scope: Optional[Scope] = None,
+    filename: str = PARAMS_FILE,
+    var_names: Optional[Sequence[str]] = None,
+) -> List[str]:
+    scope = scope or global_scope()
+    path = os.path.join(dirname, filename)
+    loaded = []
+    with np.load(path) as data:
+        names = list(data.files) if var_names is None else list(var_names)
+        for n in names:
+            if n not in data:
+                raise KeyError(f"variable {n!r} not found in {path}")
+            scope.set(n, data[n])
+            loaded.append(n)
+    return loaded
+
+
+def save_params(dirname, main_program: Optional[Program] = None, scope=None):
+    """Parameters only (no optimizer state) — fluid io.py save_params."""
+    program = main_program or default_main_program()
+    scope = scope or global_scope()
+    names = sorted(
+        v.name for v in program.parameters() if scope.has(v.name)
+    )
+    return save_vars(dirname, names, scope)
+
+
+def save_persistables(dirname, main_program: Optional[Program] = None, scope=None):
+    """Full persistable state: params + optimizer accumulators + BN stats +
+    step/LR counters — fluid io.py save_persistables."""
+    program = main_program or default_main_program()
+    scope = scope or global_scope()
+    names = sorted(
+        v.name for v in program.persistables() if scope.has(v.name)
+    )
+    return save_vars(dirname, names, scope)
+
+
+def load_params(dirname, main_program: Optional[Program] = None, scope=None):
+    program = main_program or default_main_program()
+    names = sorted(v.name for v in program.parameters())
+    return load_vars(dirname, scope, var_names=names)
+
+
+def load_persistables(dirname, main_program: Optional[Program] = None, scope=None):
+    # load whatever the file has; missing-from-program names are fine (the
+    # program may have been re-built with the same var names)
+    return load_vars(dirname, scope)
+
+
+# ---------------------------------------------------------------------------
+# inference model (prune + serialize)  — fluid io.py save_inference_model,
+# framework/prune.cc, paddle/inference/inference.h
+# ---------------------------------------------------------------------------
+
+def _prune_for_inference(
+    program: Program, feed_names: Sequence[str], target_names: Sequence[str]
+) -> Program:
+    """Dataflow-slice block 0 to the ops needed to compute `target_names`
+    from `feed_names`. clone(for_test=True) drops the backward+optimizer
+    pass and flips is_test; the walk here only slices the forward graph."""
+    pruned = program.clone(for_test=True)
+    block = pruned.global_block()
+    needed = set(target_names)
+    kept = []
+    for op in reversed(block.ops):
+        if any(o in needed for o in op.output_names()):
+            kept.append(op)
+            needed.update(op.input_names())
+    kept.reverse()
+    block.ops = kept
+
+    referenced = set(feed_names) | set(target_names)
+    for op in kept:
+        referenced.update(op.input_names())
+        referenced.update(op.output_names())
+    block.vars = {n: v for n, v in block.vars.items() if n in referenced}
+    # every declared feed must actually be consumed by the slice
+    missing = [n for n in feed_names if n not in needed]
+    if missing:
+        raise ValueError(
+            f"feed vars {missing} are not inputs of the pruned inference "
+            f"slice for targets {list(target_names)}"
+        )
+    return pruned
+
+
+def save_inference_model(
+    dirname: str,
+    feeded_var_names: Sequence[str],
+    target_vars: Sequence,
+    executor: Optional[Executor] = None,
+    main_program: Optional[Program] = None,
+    scope: Optional[Scope] = None,
+) -> None:
+    """fluid io.py save_inference_model: pruned program + params in `dirname`."""
+    program = main_program or default_main_program()
+    scope = scope or global_scope()
+    target_names = [
+        v.name if isinstance(v, Variable) else v for v in target_vars
+    ]
+    pruned = _prune_for_inference(program, feeded_var_names, target_names)
+    os.makedirs(dirname, exist_ok=True)
+    param_names = sorted(
+        v.name
+        for v in pruned.global_block().vars.values()
+        if v.persistable and scope.has(v.name)
+    )
+    save_vars(dirname, param_names, scope)
+    with open(os.path.join(dirname, PROGRAM_FILE), "w") as f:
+        json.dump(pruned.to_dict(), f)
+    with open(os.path.join(dirname, META_FILE), "w") as f:
+        json.dump(
+            {
+                "feed_names": list(feeded_var_names),
+                "fetch_names": target_names,
+                "param_names": param_names,
+            },
+            f,
+        )
+
+
+def load_inference_model(dirname: str, scope: Optional[Scope] = None):
+    """Returns (program, feed_names, fetch_names); params are loaded into
+    the scope so `Executor().run(program, feed, fetch_list)` works directly."""
+    scope = scope or global_scope()
+    with open(os.path.join(dirname, PROGRAM_FILE)) as f:
+        program = Program.from_dict(json.load(f))
+    with open(os.path.join(dirname, META_FILE)) as f:
+        meta = json.load(f)
+    load_vars(dirname, scope, var_names=meta["param_names"])
+    return program, meta["feed_names"], meta["fetch_names"]
+
+
+# ---------------------------------------------------------------------------
+# training checkpoints (ParamUtil / fluid io.py checkpoint API)
+# ---------------------------------------------------------------------------
+
+def _serial_dir(checkpoint_dir: str, serial: int) -> str:
+    return os.path.join(checkpoint_dir, f"{CHECKPOINT_PREFIX}_{serial}")
+
+
+def get_latest_checkpoint_serial(checkpoint_dir: str) -> int:
+    """Largest *complete* (meta present) checkpoint serial, or -1."""
+    if not os.path.isdir(checkpoint_dir):
+        return -1
+    best = -1
+    for name in os.listdir(checkpoint_dir):
+        m = re.fullmatch(rf"{CHECKPOINT_PREFIX}_(\d+)", name)
+        if m and os.path.exists(
+            os.path.join(checkpoint_dir, name, META_FILE)
+        ):
+            best = max(best, int(m.group(1)))
+    return best
+
+
+def save_checkpoint(
+    checkpoint_dir: str,
+    trainer_args: Optional[Dict[str, Any]] = None,
+    main_program: Optional[Program] = None,
+    scope: Optional[Scope] = None,
+    max_num_checkpoints: int = 3,
+) -> int:
+    """Save persistables + trainer metadata as a new numbered checkpoint,
+    keeping only the newest `max_num_checkpoints` (ParamUtil cadence +
+    `save_only_one` generalized). Returns the new serial."""
+    serial = get_latest_checkpoint_serial(checkpoint_dir) + 1
+    d = _serial_dir(checkpoint_dir, serial)
+    os.makedirs(d, exist_ok=True)
+    save_persistables(d, main_program, scope)
+    # meta written last: its presence marks the checkpoint complete
+    with open(os.path.join(d, META_FILE), "w") as f:
+        json.dump({"serial": serial, "trainer_args": trainer_args or {}}, f)
+    serials = sorted(
+        int(m.group(1))
+        for name in os.listdir(checkpoint_dir)
+        if (m := re.fullmatch(rf"{CHECKPOINT_PREFIX}_(\d+)", name))
+    )
+    for s in serials[:-max_num_checkpoints]:
+        shutil.rmtree(_serial_dir(checkpoint_dir, s), ignore_errors=True)
+    return serial
+
+
+def load_checkpoint(
+    checkpoint_dir: str,
+    main_program: Optional[Program] = None,
+    scope: Optional[Scope] = None,
+) -> Dict[str, Any]:
+    """Restore the newest complete checkpoint; returns its trainer_args."""
+    serial = get_latest_checkpoint_serial(checkpoint_dir)
+    if serial < 0:
+        raise FileNotFoundError(f"no checkpoint under {checkpoint_dir}")
+    d = _serial_dir(checkpoint_dir, serial)
+    load_persistables(d, main_program, scope)
+    with open(os.path.join(d, META_FILE)) as f:
+        return json.load(f)["trainer_args"]
+
+
+def clean_checkpoint(checkpoint_dir: str) -> None:
+    shutil.rmtree(checkpoint_dir, ignore_errors=True)

@@ -60,6 +60,12 @@ class LayerHelper:
         if existed:
             # shared parameter (e.g. tied embeddings): created once,
             # initialized once — don't append duplicate init ops
+            if tuple(param.shape) != tuple(shape) or np.dtype(param.dtype) != np.dtype(dtype):
+                raise ValueError(
+                    f"shared parameter {name!r} re-declared with shape "
+                    f"{tuple(shape)}/{np.dtype(dtype).name}, but it already "
+                    f"exists as {tuple(param.shape)}/{np.dtype(param.dtype).name}"
+                )
             return param
         param.regularizer = attr.regularizer
         param.grad_clip = attr.gradient_clip

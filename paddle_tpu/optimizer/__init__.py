@@ -276,6 +276,8 @@ class Optimizer:
             main_program=loss.block.program,
             startup_program=startup_program or default_startup_program(),
         )
+        block = loss.block.program.global_block()
+        opt_pass_start = len(block.ops)
         params_grads = append_backward(loss, parameter_list, no_grad_set)
 
         # regularization: grad += decay(param)  (fluid regularizer.py)
@@ -318,6 +320,11 @@ class Optimizer:
                     attrs={"scale": mult},
                 )
             self._append_update_op(helper, p, g, plr)
+        # mark the backward+update slice so io._prune_for_inference and
+        # Program test-clones can drop it wholesale (fluid marks these with
+        # op_role=Optimize; same idea)
+        for op in block.ops[opt_pass_start:]:
+            op.attrs["is_optimizer_op"] = True
         return params_grads
 
 

@@ -1,0 +1,263 @@
+"""Training driver: pass/batch loops, events, testing, checkpoint cadence.
+
+Reference surface:
+- Gen-1 `Trainer::train/trainOnePass` (paddle/trainer/Trainer.cpp:265,496):
+  pass loop → batch loop → forwardBackward → updater, per-pass Tester::test
+  and ParameterUtil::saveParameters cadence.
+- v2 `SGD.train(reader, event_handler)` (python/paddle/v2/trainer.py:137-216)
+  with events (python/paddle/v2/event.py): BeginPass/EndPass and
+  BeginIteration/EndIteration carrying cost + metrics.
+
+TPU design: one Trainer over the (main, startup) program pair; each step is
+one jitted program execution (Executor compile-caches per feed shape). Test
+programs are `main.clone(for_test=True)`. Checkpoints capture the full
+persistable Scope slice (optimizer state included) plus reader position
+metadata, so preemption-resume continues mid-training (go/pserver
+checkpointing design parity, §5.3/§5.4 of SURVEY.md).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from . import io
+from .core.executor import Executor, Scope, global_scope
+from .core.place import Place
+from .core.program import (
+    Program,
+    Variable,
+    default_main_program,
+    default_startup_program,
+)
+from .data.feeder import DataFeeder
+
+__all__ = [
+    "BeginPass",
+    "EndPass",
+    "BeginIteration",
+    "EndIteration",
+    "CheckpointConfig",
+    "Trainer",
+]
+
+
+# -- events (python/paddle/v2/event.py) -------------------------------------
+
+class BeginPass:
+    def __init__(self, pass_id: int):
+        self.pass_id = pass_id
+
+
+class EndPass:
+    def __init__(self, pass_id: int, metrics: Dict[str, float]):
+        self.pass_id = pass_id
+        self.metrics = metrics
+
+
+class BeginIteration:
+    def __init__(self, pass_id: int, batch_id: int):
+        self.pass_id = pass_id
+        self.batch_id = batch_id
+
+
+class EndIteration:
+    def __init__(self, pass_id, batch_id, step, cost, metrics):
+        self.pass_id = pass_id
+        self.batch_id = batch_id
+        self.step = step  # global step
+        self.cost = cost
+        self.metrics = metrics
+
+
+class CheckpointConfig:
+    """Cadence flags (Gen-1 `saving_period`/`saving_period_by_batches`/
+    `save_dir`, Trainer.cpp:60-64)."""
+
+    def __init__(
+        self,
+        checkpoint_dir: str,
+        epoch_interval: int = 1,
+        step_interval: int = 0,
+        max_num_checkpoints: int = 3,
+    ):
+        self.checkpoint_dir = checkpoint_dir
+        self.epoch_interval = epoch_interval
+        self.step_interval = step_interval
+        self.max_num_checkpoints = max_num_checkpoints
+
+
+class Trainer:
+    """Drives training of `fetch_list[0]` (the cost) over a reader.
+
+    reader yields batches of sample tuples aligned with `feed_order`
+    (DataFeeder handles dense/ragged conversion), or — if `feed_order` is
+    None — ready feed dicts.
+    """
+
+    def __init__(
+        self,
+        cost: Variable,
+        main_program: Optional[Program] = None,
+        startup_program: Optional[Program] = None,
+        place: Optional[Place] = None,
+        scope: Optional[Scope] = None,
+        checkpoint_config: Optional[CheckpointConfig] = None,
+        executor: Optional[Executor] = None,
+    ):
+        self.cost = cost
+        self.main_program = main_program or default_main_program()
+        self.startup_program = startup_program or default_startup_program()
+        self.scope = scope or global_scope()
+        self.exe = executor or Executor(place)
+        self.test_program = self.main_program.clone(for_test=True)
+        self.checkpoint_config = checkpoint_config
+        self._stop = False
+        self.step = 0  # global batch counter across passes
+        self.start_pass = 0
+        self._resume_batch = 0  # first batch to run in the resumed pass
+        self._initialized = False
+
+    # -- lifecycle ---------------------------------------------------------
+    def init(self) -> "Trainer":
+        """Run startup (parameter init), or resume from the newest checkpoint
+        if checkpoint_config points at one (init_model_path/start_pass
+        parity, ParamUtil.h:105-111)."""
+        self.exe.run(self.startup_program, scope=self.scope)
+        cc = self.checkpoint_config
+        if cc and io.get_latest_checkpoint_serial(cc.checkpoint_dir) >= 0:
+            args = io.load_checkpoint(
+                cc.checkpoint_dir, self.main_program, self.scope
+            )
+            self.step = int(args.get("step", 0))
+            if args.get("mid_pass"):
+                # step_interval checkpoint: re-enter the interrupted pass and
+                # skip the batches already trained (deterministic readers
+                # replay; the Go-master equivalent re-dispatches tasks)
+                self.start_pass = int(args.get("pass_id", 0))
+                self._resume_batch = int(args.get("batch_id", -1)) + 1
+            else:
+                self.start_pass = int(args.get("pass_id", -1)) + 1
+        self._initialized = True
+        return self
+
+    def stop(self):
+        """Callable from an event handler to end training (v2 trainer.stop)."""
+        self._stop = True
+
+    # -- training ----------------------------------------------------------
+    def train(
+        self,
+        reader: Callable,
+        num_passes: int,
+        feed_order: Optional[Sequence[Variable]] = None,
+        event_handler: Optional[Callable] = None,
+        fetch_metrics: Optional[Dict[str, Variable]] = None,
+        test_reader: Optional[Callable] = None,
+    ) -> Dict[str, float]:
+        """Pass/batch loop. Returns the final EndPass metrics dict."""
+        if not self._initialized:
+            self.init()
+        handler = event_handler or (lambda e: None)
+        feeder = DataFeeder(feed_order) if feed_order is not None else None
+        metric_items = sorted((fetch_metrics or {}).items())
+        fetch_list = [self.cost] + [v for _, v in metric_items]
+        last_metrics: Dict[str, float] = {}
+
+        for pass_id in range(self.start_pass, num_passes):
+            handler(BeginPass(pass_id))
+            costs, metric_sums = [], np.zeros(len(metric_items))
+            skip_until = self._resume_batch if pass_id == self.start_pass else 0
+            for batch_id, data in enumerate(reader()):
+                if self._stop:
+                    break
+                if batch_id < skip_until:
+                    continue
+                handler(BeginIteration(pass_id, batch_id))
+                feed = feeder.feed(data) if feeder else data
+                outs = self.exe.run(
+                    self.main_program,
+                    feed=feed,
+                    fetch_list=fetch_list,
+                    scope=self.scope,
+                )
+                cost = float(np.asarray(outs[0]))
+                batch_metrics = {
+                    k: float(np.asarray(v))
+                    for (k, _), v in zip(metric_items, outs[1:])
+                }
+                costs.append(cost)
+                metric_sums += np.array(
+                    [batch_metrics[k] for k, _ in metric_items]
+                ) if metric_items else 0
+                self.step += 1
+                handler(
+                    EndIteration(pass_id, batch_id, self.step, cost, batch_metrics)
+                )
+                cc = self.checkpoint_config
+                if cc and cc.step_interval and self.step % cc.step_interval == 0:
+                    self._save_checkpoint(pass_id, batch_id=batch_id)
+            n = max(len(costs), 1)
+            last_metrics = {"cost": float(np.mean(costs)) if costs else float("nan")}
+            for i, (k, _) in enumerate(metric_items):
+                last_metrics[k] = float(metric_sums[i] / n)
+            if test_reader is not None:
+                test_metrics = self.test(test_reader, feed_order, fetch_metrics)
+                last_metrics.update({f"test_{k}": v for k, v in test_metrics.items()})
+            handler(EndPass(pass_id, last_metrics))
+            cc = self.checkpoint_config
+            if cc and cc.epoch_interval and (pass_id + 1) % cc.epoch_interval == 0:
+                self._save_checkpoint(pass_id)
+            if self._stop:
+                break
+        return last_metrics
+
+    # -- testing (paddle/trainer/Tester.cpp; v2 trainer.test) --------------
+    def test(
+        self,
+        reader: Callable,
+        feed_order: Optional[Sequence[Variable]] = None,
+        fetch_metrics: Optional[Dict[str, Variable]] = None,
+    ) -> Dict[str, float]:
+        feeder = DataFeeder(feed_order) if feed_order is not None else None
+        metric_items = sorted((fetch_metrics or {}).items())
+        fetch_list = [self.cost] + [v for _, v in metric_items]
+        sums = np.zeros(len(fetch_list))
+        n = 0
+        for data in reader():
+            feed = feeder.feed(data) if feeder else data
+            outs = self.exe.run(
+                self.test_program, feed=feed, fetch_list=fetch_list, scope=self.scope
+            )
+            sums += np.array([float(np.asarray(o)) for o in outs])
+            n += 1
+        n = max(n, 1)
+        out = {"cost": float(sums[0] / n)}
+        for i, (k, _) in enumerate(metric_items):
+            out[k] = float(sums[i + 1] / n)
+        return out
+
+    # -- checkpointing ------------------------------------------------------
+    def _save_checkpoint(self, pass_id: int, batch_id: Optional[int] = None) -> None:
+        cc = self.checkpoint_config
+        args = {"pass_id": pass_id, "step": self.step, "time": time.time()}
+        if batch_id is not None:
+            args.update({"mid_pass": True, "batch_id": batch_id})
+        io.save_checkpoint(
+            cc.checkpoint_dir,
+            trainer_args=args,
+            main_program=self.main_program,
+            scope=self.scope,
+            max_num_checkpoints=cc.max_num_checkpoints,
+        )
+
+    def save_params(self, dirname: str) -> None:
+        io.save_params(dirname, self.main_program, self.scope)
+
+    def save_inference_model(self, dirname, feeded_var_names, target_vars):
+        io.save_inference_model(
+            dirname, feeded_var_names, target_vars,
+            main_program=self.main_program, scope=self.scope,
+        )

