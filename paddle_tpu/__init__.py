@@ -21,6 +21,7 @@ Quick start (fit_a_line, reference book/01)::
 
 from . import core  # noqa: F401
 from . import ops  # noqa: F401  (registers all kernels)
+from . import evaluator  # noqa: F401
 from . import initializer  # noqa: F401
 from . import io  # noqa: F401
 from . import layers  # noqa: F401

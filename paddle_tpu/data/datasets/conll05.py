@@ -1,0 +1,107 @@
+"""CoNLL-2005 semantic role labeling (reference:
+python/paddle/v2/dataset/conll05.py).
+
+Reference sample schema (test()): 9 sequence slots per (sentence, predicate)
+pair — (word_ids, ctx_n2, ctx_n1, ctx_0, ctx_p1, ctx_p2, pred_ids, mark,
+label_ids) — exactly the feeds of the label_semantic_roles book model
+(book/07). get_dict() → (word_dict, verb_dict, label_dict); label_dict uses
+the B-/I-/O tag layout the ChunkEvaluator expects.
+
+Synthetic generation: each sentence has one predicate; tokens near the
+predicate get role spans whose type depends on (token bucket, side), so the
+tagger has deterministic structure to learn.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+_WORD_VOCAB = 3000
+_N_VERBS = 50
+_N_ROLES = 4  # role types → labels B-Ai/I-Ai per type + O
+_N_TRAIN, _N_TEST = 1500, 200
+
+
+def word_dict():
+    d = {f"w{i}": i for i in range(_WORD_VOCAB)}
+    d["<unk>"] = len(d)
+    return d
+
+
+def verb_dict():
+    return {f"v{i}": i for i in range(_N_VERBS)}
+
+
+def label_dict():
+    # IOB layout: B-A0=0, I-A0=1, B-A1=2, I-A1=3, ... O=2*_N_ROLES
+    d = {}
+    for t in range(_N_ROLES):
+        d[f"B-A{t}"] = 2 * t
+        d[f"I-A{t}"] = 2 * t + 1
+    d["O"] = 2 * _N_ROLES
+    return d
+
+
+def get_dict():
+    return word_dict(), verb_dict(), label_dict()
+
+
+def get_embedding():
+    """Reference ships a pretrained emb matrix; here a fixed random one."""
+    rng = np.random.RandomState(5)
+    return rng.randn(_WORD_VOCAB + 1, 32).astype(np.float32)
+
+
+def _ctx(words, pred_pos, off):
+    """Predicate-context word at pred_pos+off, broadcast over the sequence
+    (reference conll05: ctx_n2..ctx_p2 are constant per (sentence, verb))."""
+    j = min(max(pred_pos + off, 0), len(words) - 1)
+    return words[j]
+
+
+def _reader(n, seed):
+    o_tag = 2 * _N_ROLES
+
+    def reader():
+        rng = np.random.RandomState(seed)
+        for _ in range(n):
+            length = rng.randint(6, 20)
+            words = rng.randint(0, _WORD_VOCAB, size=length).tolist()
+            pred_pos = rng.randint(1, length - 1)
+            verb = words[pred_pos] % _N_VERBS
+            labels = [o_tag] * length
+            # role span left of the predicate; type from word id parity
+            lstart = max(0, pred_pos - 3)
+            t0 = words[lstart] % 2  # A0 or A1
+            labels[lstart] = 2 * t0
+            for k in range(lstart + 1, pred_pos):
+                labels[k] = 2 * t0 + 1
+            # role span right of the predicate
+            rend = min(length, pred_pos + 1 + rng.randint(1, 4))
+            t1 = 2 + words[pred_pos + 1] % 2  # A2 or A3
+            labels[pred_pos + 1] = 2 * t1
+            for k in range(pred_pos + 2, rend):
+                labels[k] = 2 * t1 + 1
+            mark = [1 if k == pred_pos else 0 for k in range(length)]
+            preds = [verb] * length
+            yield (
+                words,
+                [_ctx(words, pred_pos, -2)] * length,
+                [_ctx(words, pred_pos, -1)] * length,
+                [_ctx(words, pred_pos, 0)] * length,
+                [_ctx(words, pred_pos, 1)] * length,
+                [_ctx(words, pred_pos, 2)] * length,
+                preds,
+                mark,
+                labels,
+            )
+
+    return reader
+
+
+def train():
+    return _reader(_N_TRAIN, 21)
+
+
+def test():
+    return _reader(_N_TEST, 22)

@@ -1,0 +1,49 @@
+"""Oxford 102 Flowers (reference: python/paddle/v2/dataset/flowers.py).
+
+Sample schema: (image[3,H,W] float32, label int), 102 classes. The reference
+decodes/augments JPEGs; here synthetic 3x64x64 class-conditional color
+fields (same scheme as cifar.py) keep the API and let image models train.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+_N_CLASSES = 102
+_N_TRAIN, _N_TEST = 2040, 510
+_H = _W = 64
+
+
+def _synthetic(n, seed):
+    rng = np.random.RandomState(4321)
+    low = rng.randn(_N_CLASSES, 3, 8, 8).astype(np.float32)
+    templates = low.repeat(_H // 8, axis=2).repeat(_W // 8, axis=3)
+    rng = np.random.RandomState(seed)
+    labels = rng.randint(0, _N_CLASSES, size=n)
+    for i in range(n):
+        img = templates[labels[i]] * 0.5 + 0.35 * rng.randn(3, _H, _W).astype(np.float32)
+        yield 1.0 / (1.0 + np.exp(-img)), int(labels[i])
+
+
+def train(mapper=None, buffered_size: int = 1024, use_xmap: bool = False):
+    def reader():
+        for img, lbl in _synthetic(_N_TRAIN, 71):
+            yield (mapper((img, lbl)) if mapper else (img, lbl))
+
+    return reader
+
+
+def test(mapper=None, buffered_size: int = 1024, use_xmap: bool = False):
+    def reader():
+        for img, lbl in _synthetic(_N_TEST, 72):
+            yield (mapper((img, lbl)) if mapper else (img, lbl))
+
+    return reader
+
+
+def valid(mapper=None, buffered_size: int = 1024, use_xmap: bool = False):
+    def reader():
+        for img, lbl in _synthetic(_N_TEST, 73):
+            yield (mapper((img, lbl)) if mapper else (img, lbl))
+
+    return reader

@@ -1,0 +1,124 @@
+"""MovieLens-1M (reference: python/paddle/v2/dataset/movielens.py).
+
+Reference sample schema (train()/test()):
+(user_id, gender_id, age_id, job_id, movie_id, category_ids, title_ids,
+ score) — the 8 feed slots of the recommender_system book model (book/05).
+Helper API: max_user_id/max_movie_id/max_job_id, age_table,
+movie_categories(), user_info(), movie_info().
+
+With no egress, users/movies get latent factors and ratings follow
+score = clip(round(u·v + biases), 1..5), so the dual-tower regression model
+has real signal to learn.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+age_table = [1, 18, 25, 35, 45, 50, 56]
+
+_N_USERS = 400
+_N_MOVIES = 300
+_N_JOBS = 21
+_N_CATEGORIES = 18
+_TITLE_VOCAB = 1000
+_N_TRAIN, _N_TEST = 6000, 600
+_DIM = 6
+
+
+def max_user_id() -> int:
+    return _N_USERS
+
+
+def max_movie_id() -> int:
+    return _N_MOVIES
+
+
+def max_job_id() -> int:
+    return _N_JOBS - 1
+
+
+def movie_categories():
+    return {f"genre{i}": i for i in range(_N_CATEGORIES)}
+
+
+def get_movie_title_dict():
+    return {f"t{i}": i for i in range(_TITLE_VOCAB)}
+
+
+def _factors():
+    rng = np.random.RandomState(2024)
+    u = rng.randn(_N_USERS + 1, _DIM) * 0.8
+    v = rng.randn(_N_MOVIES + 1, _DIM) * 0.8
+    ub = rng.randn(_N_USERS + 1) * 0.3
+    vb = rng.randn(_N_MOVIES + 1) * 0.3
+    genders = rng.randint(0, 2, _N_USERS + 1)
+    ages = rng.randint(0, len(age_table), _N_USERS + 1)
+    jobs = rng.randint(0, _N_JOBS, _N_USERS + 1)
+    cats = [
+        sorted(rng.choice(_N_CATEGORIES, size=rng.randint(1, 4), replace=False))
+        for _ in range(_N_MOVIES + 1)
+    ]
+    titles = [
+        list(rng.randint(0, _TITLE_VOCAB, size=rng.randint(2, 6)))
+        for _ in range(_N_MOVIES + 1)
+    ]
+    return u, v, ub, vb, genders, ages, jobs, cats, titles
+
+
+_F = None
+
+
+def _get_factors():
+    global _F
+    if _F is None:
+        _F = _factors()
+    return _F
+
+
+def user_info():
+    _, _, _, _, genders, ages, jobs, _, _ = _get_factors()
+    return {
+        i: {"gender": int(genders[i]), "age": int(ages[i]), "job": int(jobs[i])}
+        for i in range(1, _N_USERS + 1)
+    }
+
+
+def movie_info():
+    *_, cats, titles = _get_factors()
+    return {
+        i: {"categories": [int(c) for c in cats[i]], "title": [int(t) for t in titles[i]]}
+        for i in range(1, _N_MOVIES + 1)
+    }
+
+
+def _reader(n, seed):
+    u, v, ub, vb, genders, ages, jobs, cats, titles = _get_factors()
+
+    def reader():
+        rng = np.random.RandomState(seed)
+        for _ in range(n):
+            uid = rng.randint(1, _N_USERS + 1)
+            mid = rng.randint(1, _N_MOVIES + 1)
+            raw = u[uid] @ v[mid] + ub[uid] + vb[mid] + 3.0 + 0.2 * rng.randn()
+            score = float(np.clip(np.round(raw), 1, 5))
+            yield (
+                uid,
+                int(genders[uid]),
+                int(ages[uid]),
+                int(jobs[uid]),
+                mid,
+                [int(c) for c in cats[mid]],
+                [int(t) for t in titles[mid]],
+                score,
+            )
+
+    return reader
+
+
+def train():
+    return _reader(_N_TRAIN, 11)
+
+
+def test():
+    return _reader(_N_TEST, 12)

@@ -1,0 +1,63 @@
+"""MQ2007 learning-to-rank (reference: python/paddle/v2/dataset/mq2007.py).
+
+Reference API: train(format=...)/test(format=...) with three views:
+- "pointwise": (feature[46], relevance float)
+- "pairwise":  (feature_hi[46], feature_lo[46]) with rel(hi) > rel(lo)
+- "listwise":  (label_list, feature_matrix) per query
+
+Synthetic data: per-query docs with a hidden linear relevance model over the
+46 LETOR features (plus noise), quantized to 0/1/2 like the corpus.
+"""
+
+from __future__ import annotations
+
+import itertools
+
+import numpy as np
+
+FEATURE_DIM = 46
+_N_QUERIES_TRAIN, _N_QUERIES_TEST = 200, 40
+_DOCS_PER_QUERY = 8
+
+
+def _w():
+    return np.linspace(-1, 1, FEATURE_DIM).astype(np.float32)
+
+
+def _queries(n_queries, seed):
+    w = _w()
+    rng = np.random.RandomState(seed)
+    for _ in range(n_queries):
+        feats = rng.randn(_DOCS_PER_QUERY, FEATURE_DIM).astype(np.float32)
+        score = feats @ w + 0.3 * rng.randn(_DOCS_PER_QUERY)
+        rel = np.digitize(score, np.quantile(score, [0.5, 0.85])).astype(np.int64)
+        yield rel, feats
+
+
+def _reader(n_queries, seed, format):
+    if format not in ("pointwise", "pairwise", "listwise"):
+        raise ValueError(f"unknown format {format!r}")
+
+    def reader():
+        for rel, feats in _queries(n_queries, seed):
+            if format == "pointwise":
+                for r, f in zip(rel, feats):
+                    yield f, float(r)
+            elif format == "pairwise":
+                for i, j in itertools.combinations(range(len(rel)), 2):
+                    if rel[i] > rel[j]:
+                        yield feats[i], feats[j]
+                    elif rel[j] > rel[i]:
+                        yield feats[j], feats[i]
+            else:
+                yield rel.tolist(), feats
+
+    return reader
+
+
+def train(format: str = "pairwise"):
+    return _reader(_N_QUERIES_TRAIN, 61, format)
+
+
+def test(format: str = "pairwise"):
+    return _reader(_N_QUERIES_TEST, 62, format)

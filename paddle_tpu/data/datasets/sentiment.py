@@ -1,0 +1,45 @@
+"""Movie-review sentiment (reference: python/paddle/v2/dataset/sentiment.py,
+NLTK movie_reviews corpus). Sample schema: (word_ids list[int], label 0/1).
+
+Synthetic data shares the class-conditional token-distribution scheme of
+imdb.py with a smaller vocabulary (the reference corpus is ~39k tokens over
+2k documents; scaled down proportionally here).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+_VOCAB = 2000
+_N_TRAIN, _N_TEST = 1600, 400
+
+
+def get_word_dict():
+    return {f"w{i}": i for i in range(_VOCAB)}
+
+
+def _reader(n, seed):
+    def reader():
+        rng = np.random.RandomState(seed)
+        half = _VOCAB // 2
+        for _ in range(n):
+            label = int(rng.randint(0, 2))
+            length = rng.randint(10, 60)
+            # positive docs over-sample the first vocab half 3:1
+            biased = rng.rand(length) < 0.75
+            ids = np.where(
+                biased == (label == 0),
+                rng.randint(0, half, size=length),
+                rng.randint(half, _VOCAB, size=length),
+            )
+            yield ids.tolist(), label
+
+    return reader
+
+
+def train():
+    return _reader(_N_TRAIN, 51)
+
+
+def test():
+    return _reader(_N_TEST, 52)

@@ -1,0 +1,51 @@
+"""Pascal VOC2012 segmentation (reference: python/paddle/v2/dataset/voc2012.py).
+
+Sample schema: (image[3,H,W] float32, label_map[H,W] int32 in [0,21)) —
+21 classes incl. background. Synthetic scenes place 1-3 solid-color
+rectangles (class-correlated colors) on a textured background so a small
+segmentation head can learn pixel classes.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+N_CLASSES = 21
+_H = _W = 64
+_N_TRAIN, _N_TEST = 600, 120
+
+
+def _scene(rng):
+    img = 0.1 * rng.randn(3, _H, _W).astype(np.float32) + 0.4
+    lbl = np.zeros((_H, _W), np.int32)
+    colors = np.linspace(0, 1, N_CLASSES)
+    for _ in range(rng.randint(1, 4)):
+        c = rng.randint(1, N_CLASSES)
+        h, w = rng.randint(8, 32), rng.randint(8, 32)
+        y, x = rng.randint(0, _H - h), rng.randint(0, _W - w)
+        img[0, y : y + h, x : x + w] = colors[c]
+        img[1, y : y + h, x : x + w] = 1 - colors[c]
+        img[2, y : y + h, x : x + w] = (c % 5) / 5.0
+        lbl[y : y + h, x : x + w] = c
+    return img, lbl
+
+
+def _reader(n, seed):
+    def reader():
+        rng = np.random.RandomState(seed)
+        for _ in range(n):
+            yield _scene(rng)
+
+    return reader
+
+
+def train():
+    return _reader(_N_TRAIN, 81)
+
+
+def test():
+    return _reader(_N_TEST, 82)
+
+
+def val():
+    return _reader(_N_TEST, 83)

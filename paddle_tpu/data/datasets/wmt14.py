@@ -1,0 +1,67 @@
+"""WMT14 fr→en translation (reference: python/paddle/v2/dataset/wmt14.py).
+
+Reference sample schema (train(dict_size)): (src_ids, trg_ids, trg_ids_next)
+where trg_ids is <s>-prefixed and trg_ids_next is the shifted target ending
+in <e> — the three feeds of the machine_translation book model (book/08).
+Special ids follow the reference: <s>=0, <e>=1, <unk>=2.
+
+Synthetic generation: the "translation" of a source sentence is its reversal
+with a fixed vocabulary permutation — a deterministic mapping that a
+seq2seq-with-attention model can actually learn, giving the acceptance test
+a convergence signal.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+START = "<s>"
+END = "<e>"
+UNK = "<unk>"
+START_ID, END_ID, UNK_ID = 0, 1, 2
+_RESERVED = 3
+
+_N_TRAIN, _N_TEST = 3000, 300
+
+
+def _perm(dict_size, seed=17):
+    rng = np.random.RandomState(seed)
+    content = dict_size - _RESERVED
+    return rng.permutation(content)
+
+
+def _reader(dict_size, n, seed):
+    perm = _perm(dict_size)
+    content = dict_size - _RESERVED
+
+    def reader():
+        rng = np.random.RandomState(seed)
+        for _ in range(n):
+            length = rng.randint(3, 12)
+            src = rng.randint(0, content, size=length)
+            trg = perm[src[::-1]] + _RESERVED
+            src = src + _RESERVED
+            trg_in = [START_ID] + trg.tolist()
+            trg_next = trg.tolist() + [END_ID]
+            yield src.tolist(), trg_in, trg_next
+
+    return reader
+
+
+def train(dict_size: int):
+    return _reader(dict_size, _N_TRAIN, 31)
+
+
+def test(dict_size: int):
+    return _reader(dict_size, _N_TEST, 32)
+
+
+def get_dict(dict_size: int, reverse: bool = False):
+    """Reference API: (src_dict, trg_dict); synthetic vocab tokens."""
+    def mk():
+        d = {START: START_ID, END: END_ID, UNK: UNK_ID}
+        for i in range(dict_size - _RESERVED):
+            d[f"tok{i}"] = i + _RESERVED
+        return {v: k for k, v in d.items()} if reverse else d
+
+    return mk(), mk()

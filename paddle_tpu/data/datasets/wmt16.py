@@ -1,0 +1,25 @@
+"""WMT16 en↔de (reference: python/paddle/v2/dataset/wmt16.py).
+
+Same sample schema as wmt14 — (src_ids, trg_ids(<s>-prefixed),
+trg_ids_next(<e>-suffixed)) — with configurable src/trg dict sizes.
+Synthetic mapping: reversal + vocabulary permutation (see wmt14.py).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from . import wmt14
+
+
+def train(src_dict_size: int, trg_dict_size: int, src_lang: str = "en"):
+    return wmt14._reader(min(src_dict_size, trg_dict_size), wmt14._N_TRAIN, 41)
+
+
+def test(src_dict_size: int, trg_dict_size: int, src_lang: str = "en"):
+    return wmt14._reader(min(src_dict_size, trg_dict_size), wmt14._N_TEST, 42)
+
+
+def get_dict(lang: str, dict_size: int, reverse: bool = False):
+    d, _ = wmt14.get_dict(dict_size, reverse)
+    return d

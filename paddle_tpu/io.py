@@ -113,9 +113,15 @@ def save_params(dirname, main_program: Optional[Program] = None, scope=None):
     """Parameters only (no optimizer state) — fluid io.py save_params."""
     program = main_program or default_main_program()
     scope = scope or global_scope()
-    names = sorted(
-        v.name for v in program.parameters() if scope.has(v.name)
+    missing = sorted(
+        v.name for v in program.parameters() if not scope.has(v.name)
     )
+    if missing:
+        raise ValueError(
+            f"save_params: parameters {missing} are not in the scope — "
+            f"did the startup program run?"
+        )
+    names = sorted(v.name for v in program.parameters())
     return save_vars(dirname, names, scope)
 
 

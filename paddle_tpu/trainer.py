@@ -160,6 +160,7 @@ class Trainer:
         """Pass/batch loop. Returns the final EndPass metrics dict."""
         if not self._initialized:
             self.init()
+        self._stop = False
         handler = event_handler or (lambda e: None)
         feeder = DataFeeder(feed_order) if feed_order is not None else None
         metric_items = sorted((fetch_metrics or {}).items())
@@ -169,10 +170,13 @@ class Trainer:
         for pass_id in range(self.start_pass, num_passes):
             handler(BeginPass(pass_id))
             costs, metric_sums = [], np.zeros(len(metric_items))
-            skip_until = self._resume_batch if pass_id == self.start_pass else 0
+            skip_until = self._resume_batch
+            self._resume_batch = 0  # only the resumed pass skips
+            last_batch_id = -1
             for batch_id, data in enumerate(reader()):
                 if self._stop:
                     break
+                last_batch_id = batch_id
                 if batch_id < skip_until:
                     continue
                 handler(BeginIteration(pass_id, batch_id))
@@ -208,10 +212,14 @@ class Trainer:
                 last_metrics.update({f"test_{k}": v for k, v in test_metrics.items()})
             handler(EndPass(pass_id, last_metrics))
             cc = self.checkpoint_config
+            if self._stop:
+                # interrupted mid-pass: checkpoint must record the batch
+                # position so resume re-enters this pass, not the next one
+                if cc and last_batch_id >= 0:
+                    self._save_checkpoint(pass_id, batch_id=last_batch_id)
+                break
             if cc and cc.epoch_interval and (pass_id + 1) % cc.epoch_interval == 0:
                 self._save_checkpoint(pass_id)
-            if self._stop:
-                break
         return last_metrics
 
     # -- testing (paddle/trainer/Tester.cpp; v2 trainer.test) --------------

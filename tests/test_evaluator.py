@@ -1,0 +1,133 @@
+"""Evaluator suite tests (reference: gserver/evaluators + their tests,
+gserver/tests/test_Evaluator.cpp). Each metric is checked against a
+hand-computed or sklearn-style closed-form value on small fixtures.
+"""
+
+import numpy as np
+import pytest
+
+from paddle_tpu.evaluator import (
+    Accuracy,
+    Auc,
+    ChunkEvaluator,
+    DetectionMAP,
+    EditDistance,
+    PrecisionRecall,
+)
+
+
+def test_accuracy_streaming():
+    ev = Accuracy()
+    ev.update(np.array([[0.9, 0.1], [0.2, 0.8]]), np.array([0, 0]))  # 1/2
+    ev.update(np.array([[0.1, 0.9]]), np.array([1]))  # 1/1
+    assert ev.eval() == pytest.approx(2 / 3)
+    ev.reset()
+    assert ev.eval() == 0.0
+
+
+def test_precision_recall_binary():
+    ev = PrecisionRecall(2)
+    # pred ids: 1,1,0,0 ; labels: 1,0,1,0 → TP=1 FP=1 FN=1
+    ev.update(np.array([1, 1, 0, 0]), np.array([1, 0, 1, 0]))
+    p, r, f1 = ev.eval()
+    assert p == pytest.approx(0.5) and r == pytest.approx(0.5)
+    assert f1 == pytest.approx(0.5)
+
+
+def test_precision_recall_macro():
+    ev = PrecisionRecall(3)
+    ev.update(np.array([0, 1, 2, 2]), np.array([0, 1, 1, 2]))
+    s = ev.eval_all()
+    np.testing.assert_allclose(s["precision"], [1.0, 1.0, 0.5])
+    np.testing.assert_allclose(s["recall"], [1.0, 0.5, 1.0])
+
+
+def test_auc_matches_exact():
+    rng = np.random.RandomState(0)
+    scores = rng.rand(2000)
+    labels = (rng.rand(2000) < scores).astype(int)  # correlated → AUC > 0.5
+
+    # exact AUC by rank statistic
+    pos, neg = scores[labels == 1], scores[labels == 0]
+    exact = (
+        np.sum([np.sum(p > neg) + 0.5 * np.sum(p == neg) for p in pos])
+        / (len(pos) * len(neg))
+    )
+    ev = Auc()
+    ev.update(scores[:1000], labels[:1000])
+    ev.update(scores[1000:], labels[1000:])
+    assert ev.eval() == pytest.approx(exact, abs=2e-3)
+
+
+def test_auc_degenerate():
+    ev = Auc()
+    ev.update(np.array([0.5]), np.array([1]))
+    assert ev.eval() == 0.0  # no negatives
+
+
+def test_chunk_iob_f1():
+    # 2 chunk types, IOB: tags B0=0 I0=1 B1=2 I1=3 O=4
+    ev = ChunkEvaluator(num_chunk_types=2, chunk_scheme="iob")
+    label = [0, 1, 4, 2, 3, 3]        # chunks: (0,[0,2)), (1,[3,6))
+    pred = [0, 1, 4, 2, 4, 2]         # chunks: (0,[0,2)), (1,[3,4)), (1,[5,6))
+    ev.update_sequence(pred, label)
+    p, r, f1 = ev.eval()
+    assert p == pytest.approx(1 / 3)
+    assert r == pytest.approx(1 / 2)
+
+
+def test_chunk_iobes_and_plain():
+    # IOBES 1 type: B=0 I=1 E=2 S=3 O=4
+    ev = ChunkEvaluator(1, "iobes")
+    ev.update_sequence([3, 4, 0, 1, 2], [3, 4, 0, 1, 2])
+    assert ev.eval() == (1.0, 1.0, pytest.approx(1.0))
+    ev2 = ChunkEvaluator(2, "plain")
+    ev2.update_sequence([0, 0, 2, 1, 1], [0, 0, 2, 1, 1])
+    p, r, f1 = ev2.eval()
+    assert (p, r) == (1.0, 1.0)
+
+
+def test_edit_distance():
+    ev = EditDistance(normalized=False)
+    assert ev.update_sequence([1, 2, 3], [1, 3]) == 1.0  # one deletion
+    assert ev.update_sequence([5], [5]) == 0.0
+    assert ev.eval() == pytest.approx(0.5)
+    assert ev.instance_error_rate == pytest.approx(0.5)
+    evn = EditDistance(normalized=True)
+    assert evn.update_sequence([9, 9, 9, 9], [1, 2]) == pytest.approx(2.0)
+
+
+def test_detection_map_perfect_and_miss():
+    ev = DetectionMAP(num_classes=2, overlap_threshold=0.5)
+    gt_boxes = np.array([[0, 0, 10, 10], [20, 20, 30, 30]], float)
+    gt_labels = np.array([0, 1])
+    dets = np.array([
+        [0, 0.9, 0, 0, 10, 10],      # perfect match class 0
+        [1, 0.8, 20, 20, 30, 30],    # perfect match class 1
+    ])
+    ev.update_image(dets, gt_boxes, gt_labels)
+    assert ev.eval() == pytest.approx(1.0)
+
+    ev.reset()
+    dets_bad = np.array([[0, 0.9, 50, 50, 60, 60]])  # no overlap
+    ev.update_image(dets_bad, gt_boxes, gt_labels)
+    assert ev.eval() == pytest.approx(0.0)
+
+
+def test_detection_map_ranked():
+    # one GT, two detections: high-score FP then TP → integral AP = 0.5
+    ev = DetectionMAP(num_classes=1)
+    ev.update_image(
+        np.array([[0, 0.9, 50, 50, 60, 60], [0, 0.5, 0, 0, 10, 10]]),
+        np.array([[0, 0, 10, 10]], float),
+        np.array([0]),
+    )
+    assert ev.eval() == pytest.approx(0.5)
+    # 11-point interpolation for the same fixture
+    ev11 = DetectionMAP(num_classes=1, ap_version="11point")
+    ev11.update_image(
+        np.array([[0, 0.9, 50, 50, 60, 60], [0, 0.5, 0, 0, 10, 10]]),
+        np.array([[0, 0, 10, 10]], float),
+        np.array([0]),
+    )
+    assert ev11.eval() == pytest.approx(0.5)
