@@ -153,7 +153,8 @@ class Auc(Evaluator):
             return 0.0
         tpr = tp / tot_p
         fpr = fp / tot_n
-        return float(np.trapezoid(tpr, fpr))
+        trapezoid = getattr(np, "trapezoid", None) or np.trapz  # numpy<2
+        return float(trapezoid(tpr, fpr))
 
 
 def _extract_chunks(

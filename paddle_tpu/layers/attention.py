@@ -1,0 +1,152 @@
+"""Attention decoder / beam-search layer DSL.
+
+Reference: the v2 book's `simple_attention` + `recurrent_group` decoder
+(trainer_config_helpers/networks.py) driven by RecurrentGradientMachine
+(gserver/gradientmachines/RecurrentGradientMachine.h:307,309), and Fluid's
+beam_search / beam_search_decode ops. Training and generation share
+parameters by NAME (pass the same `name` to both) — the scope keeps the
+trained values, generation programs pick them up like the reference's
+generation config reusing the trained model.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from ..initializer import XavierInitializer
+from ..param_attr import ParamAttr
+from .helper import LayerHelper
+
+__all__ = ["attention_gru_decoder", "attention_gru_beam_search"]
+
+
+def _decoder_params(helper, ctx_dim, emb_dim, hidden, att_size):
+    """Create (or re-bind by name) the shared decoder parameter set."""
+    n = helper.name
+    xav = XavierInitializer()
+    p = lambda suffix, shape: helper.create_parameter(
+        ParamAttr(name=f"{n}.{suffix}"), shape, default_initializer=xav
+    )
+    return {
+        "WaEnc": p("wa_enc", (ctx_dim, att_size)),
+        "WaDec": p("wa_dec", (hidden, att_size)),
+        "Va": p("va", (att_size,)),
+        "Wx": p("wx", (emb_dim + ctx_dim, 3 * hidden)),
+        "Wh": p("wh", (hidden, 3 * hidden)),
+        "Bias": helper.create_parameter(
+            ParamAttr(name=f"{n}.b"), (3 * hidden,), is_bias=True
+        ),
+    }
+
+
+def attention_gru_decoder(
+    enc_state,
+    trg_emb,
+    boot_state,
+    size: int,
+    att_size: Optional[int] = None,
+    src_max_len: Optional[int] = None,
+    trg_max_len: Optional[int] = None,
+    name=None,
+):
+    """Teacher-forced attention GRU decoder returning per-target-token
+
+    hidden states (lod aligned with trg_emb). `size` = decoder hidden H;
+    enc_state is the [.., C] encoder LoD output; boot_state [B, H]."""
+    helper = LayerHelper("att_gru_decoder", name=name)
+    ctx_dim = int(enc_state.shape[-1])
+    emb_dim = int(trg_emb.shape[-1])
+    att_size = att_size or size
+    params = _decoder_params(helper, ctx_dim, emb_dim, size, att_size)
+    out = helper.create_tmp_variable(trg_emb.dtype, (-1, size), lod_level=1)
+    helper.append_op(
+        type="attention_gru_decoder",
+        inputs={
+            "EncState": [enc_state],
+            "TrgEmb": [trg_emb],
+            "H0": [boot_state],
+            **{k: [v] for k, v in params.items()},
+        },
+        outputs={"Hidden": [out]},
+        attrs={"src_max_len": src_max_len, "trg_max_len": trg_max_len},
+    )
+    return out
+
+
+def attention_gru_beam_search(
+    enc_state,
+    boot_state,
+    embedding_param,
+    out_w_param,
+    out_b_param,
+    size: int,
+    att_size: Optional[int] = None,
+    beam_size: int = 4,
+    max_len: int = 32,
+    bos_id: int = 0,
+    eos_id: int = 1,
+    src_max_len: Optional[int] = None,
+    length_normalize: bool = False,
+    name=None,
+):
+    """Beam-search generation with the decoder named `name` (share with the
+
+    training-time attention_gru_decoder). embedding_param / out_w_param /
+    out_b_param are the target embedding table [V, E] and output projection
+    [H, V], [V] — pass the Variables (or names) used at training time.
+    Returns (ids [B,K,T] int32, scores [B,K], lengths [B,K] int32)."""
+    helper = LayerHelper("att_gru_decoder", name=name)
+    ctx_dim = int(enc_state.shape[-1])
+    gb = helper.main_program.global_block()
+
+    def as_var(v):
+        """Bind a trained parameter by name: from this program if declared,
+        else re-declare it with the shape found in the global scope (the
+        fresh-generation-program case)."""
+        if not isinstance(v, str):
+            return v
+        if gb.has_var(v):
+            return gb.var(v)
+        from ..core.executor import global_scope
+
+        scope = global_scope()
+        if scope.has(v):
+            val = scope.get(v)
+            return helper.create_parameter(
+                ParamAttr(name=v), tuple(val.shape), dtype=np.dtype(str(val.dtype))
+            )
+        raise KeyError(
+            f"parameter {v!r} is neither declared in this program nor "
+            f"present in the global scope — train it first or pass a Variable"
+        )
+
+    emb_v, w_out, b_out = map(as_var, (embedding_param, out_w_param, out_b_param))
+    emb_dim = int(emb_v.shape[-1])
+    att_size = att_size or size
+    params = _decoder_params(helper, ctx_dim, emb_dim, size, att_size)
+    ids = helper.create_tmp_variable(np.int32, (-1, beam_size, max_len))
+    scores = helper.create_tmp_variable(enc_state.dtype, (-1, beam_size))
+    lengths = helper.create_tmp_variable(np.int32, (-1, beam_size))
+    helper.append_op(
+        type="attention_gru_beam_search",
+        inputs={
+            "EncState": [enc_state],
+            "H0": [boot_state],
+            "Embedding": [emb_v],
+            "WOut": [w_out],
+            "BOut": [b_out],
+            **{k: [v] for k, v in params.items()},
+        },
+        outputs={"Ids": [ids], "Scores": [scores], "Lengths": [lengths]},
+        attrs={
+            "beam_size": beam_size,
+            "max_len": max_len,
+            "bos_id": bos_id,
+            "eos_id": eos_id,
+            "src_max_len": src_max_len,
+            "length_normalize": length_normalize,
+        },
+    )
+    return ids, scores, lengths

@@ -165,7 +165,10 @@ def sequence_expand(x, y, name=None):
 
 def sequence_concat(input, name=None):
     helper = LayerHelper("sequence_concat", name=name)
-    out = helper.create_tmp_variable(input[0].dtype, input[0].shape, lod_level=1)
+    feat = sum(int(x.shape[-1]) for x in input)
+    out = helper.create_tmp_variable(
+        input[0].dtype, tuple(input[0].shape[:-1]) + (feat,), lod_level=1
+    )
     helper.append_op(
         type="sequence_concat", inputs={"X": list(input)}, outputs={"Out": [out]}
     )

@@ -1,0 +1,132 @@
+"""Seq2seq with attention (the book machine_translation model).
+
+Reference: the v2 book NMT config (bidirectional GRU encoder +
+simple_attention GRU decoder run by RecurrentGradientMachine —
+demo machine_translation; fluid tests/book/test_machine_translation.py)
+with beam-search generation (RecurrentGradientMachine::beamSearch :309).
+
+Training and generation are two programs sharing parameters BY NAME in the
+scope: build the train program with `seq2seq_attention(...)`, train, then
+build a fresh program with `seq2seq_beam_decode(...)` using the same
+`name` prefix — it re-binds the trained weights.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+import paddle_tpu.layers as layers
+from ..param_attr import ParamAttr
+
+__all__ = ["seq2seq_attention", "seq2seq_beam_decode"]
+
+
+def _encoder(src_words, src_vocab, emb_dim, enc_hidden, src_max_len, prefix):
+    src_emb = layers.embedding(
+        src_words, size=[src_vocab, emb_dim], param_attr=f"{prefix}.src_emb"
+    )
+    fwd_proj = layers.fc(
+        src_emb, size=3 * enc_hidden, bias_attr=False,
+        param_attr=f"{prefix}.enc_fwd_proj",
+    )
+    enc_fwd = layers.dynamic_gru(
+        fwd_proj, size=enc_hidden, max_len=src_max_len,
+        param_attr=f"{prefix}.enc_fwd_w", bias_attr=f"{prefix}.enc_fwd_b",
+    )
+    bwd_proj = layers.fc(
+        src_emb, size=3 * enc_hidden, bias_attr=False,
+        param_attr=f"{prefix}.enc_bwd_proj",
+    )
+    enc_bwd = layers.dynamic_gru(
+        bwd_proj, size=enc_hidden, is_reverse=True, max_len=src_max_len,
+        param_attr=f"{prefix}.enc_bwd_w", bias_attr=f"{prefix}.enc_bwd_b",
+    )
+    enc = layers.sequence_concat([enc_fwd, enc_bwd])  # [.., 2H]
+    # decoder boot: first step of the backward encoder → tanh fc
+    boot_src = layers.sequence_first_step(enc_bwd)
+    return enc, boot_src
+
+
+def seq2seq_attention(
+    src_words,
+    trg_words_in,
+    src_vocab: int,
+    trg_vocab: int,
+    emb_dim: int = 32,
+    enc_hidden: int = 32,
+    dec_hidden: int = 32,
+    src_max_len: Optional[int] = None,
+    trg_max_len: Optional[int] = None,
+    name: str = "s2s",
+):
+    """Training net (teacher forcing): returns per-token logits (LoD aligned
+
+    with trg_words_in). Feed trg_words_in = <bos> + target[:-1]; label =
+    target (+ <eos>)."""
+    enc, boot_src = _encoder(
+        src_words, src_vocab, emb_dim, enc_hidden, src_max_len, name
+    )
+    boot = layers.fc(
+        boot_src, size=dec_hidden, act="tanh",
+        param_attr=f"{name}.boot_w", bias_attr=f"{name}.boot_b",
+    )
+    trg_emb = layers.embedding(
+        trg_words_in, size=[trg_vocab, emb_dim], param_attr=f"{name}.trg_emb"
+    )
+    dec_h = layers.attention_gru_decoder(
+        enc, trg_emb, boot, size=dec_hidden,
+        src_max_len=src_max_len, trg_max_len=trg_max_len, name=f"{name}.dec",
+    )
+    logits = layers.fc(
+        dec_h, size=trg_vocab,
+        param_attr=f"{name}.out_w", bias_attr=f"{name}.out_b",
+    )
+    return logits
+
+
+def seq2seq_beam_decode(
+    src_words,
+    src_vocab: int,
+    trg_vocab: int,
+    emb_dim: int = 32,
+    enc_hidden: int = 32,
+    dec_hidden: int = 32,
+    beam_size: int = 4,
+    max_len: int = 32,
+    bos_id: int = 0,
+    eos_id: int = 1,
+    src_max_len: Optional[int] = None,
+    length_normalize: bool = False,
+    name: str = "s2s",
+):
+    """Generation net: beam search with the weights trained under `name`.
+
+    Returns (ids [B,K,T], scores [B,K], lengths [B,K])."""
+    enc, boot_src = _encoder(
+        src_words, src_vocab, emb_dim, enc_hidden, src_max_len, name
+    )
+    boot = layers.fc(
+        boot_src, size=dec_hidden, act="tanh",
+        param_attr=f"{name}.boot_w", bias_attr=f"{name}.boot_b",
+    )
+    # re-declare the shared tables so they exist in this program
+    import paddle_tpu.layers.helper as _h
+
+    helper = _h.LayerHelper("s2s_decode", name=f"{name}.bind")
+    trg_emb_w = helper.create_parameter(
+        ParamAttr(name=f"{name}.trg_emb"), (trg_vocab, emb_dim)
+    )
+    out_w = helper.create_parameter(
+        ParamAttr(name=f"{name}.out_w"), (dec_hidden, trg_vocab)
+    )
+    out_b = helper.create_parameter(
+        ParamAttr(name=f"{name}.out_b"), (trg_vocab,), is_bias=True
+    )
+    return layers.attention_gru_beam_search(
+        enc, boot, trg_emb_w, out_w, out_b,
+        size=dec_hidden, beam_size=beam_size, max_len=max_len,
+        bos_id=bos_id, eos_id=eos_id, src_max_len=src_max_len,
+        length_normalize=length_normalize, name=f"{name}.dec",
+    )

@@ -1,0 +1,200 @@
+"""Attention decoder + beam-search generation kernels.
+
+Reference: the RecurrentGradientMachine (gserver/gradientmachines/
+RecurrentGradientMachine.h:32 — per-timestep frames :428, memory links :342,
+generateSequence :307, beamSearch :309) running the v2 book's
+`simple_attention` recurrent group (trainer_config_helpers/networks.py),
+and the Fluid counterparts beam_search_op.cc / beam_search_decode_op.cc.
+
+TPU design: the reference clones a sub-network per timestep and walks
+frames imperatively; here the whole decoder is ONE `lax.scan` whose body
+fuses the attention score matmul, the masked softmax over source tokens,
+the context reduction, and the GRU cell — XLA keeps the per-step state
+(beam hypotheses, finished masks) resident on-chip. Beam search runs with
+static shapes: a fixed `max_len` step count, `[B, K]` beam state, and a
+`(parent, token)` trellis that is backtracked with a second scan — the
+dynamic-length output of the reference becomes fixed-max-len + per-beam
+length, which a host-side helper trims at EOS.
+
+Attention is Bahdanau-style (the v2 book's simple_attention):
+    score(s_j, h) = v · tanh(enc_proj_j + W_dec h)
+with enc_proj precomputed once per batch ([B, S, A]) so each decode step
+costs one [B, A]·[A] broadcast plus the softmax-weighted context sum.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.lod import LoDArray
+from ..core.registry import register_op
+from .rnn_ops import gru_cell
+
+
+def _attention(h, enc, enc_proj, enc_mask, w_dec, v_att):
+    """Bahdanau attention: h [B, H] or [B, K, H] → context [.., C].
+
+    enc [B, S, C], enc_proj [B, S, A] (precomputed enc @ WaEnc),
+    enc_mask [B, S]; score(s_j, h) = v · tanh(enc_proj_j + W_dec h)."""
+    dec_proj = jnp.dot(h, w_dec, preferred_element_type=jnp.float32).astype(h.dtype)
+    if h.ndim == 2:
+        t = jnp.tanh(enc_proj + dec_proj[:, None, :])  # [B, S, A]
+        scores = jnp.dot(t, v_att, preferred_element_type=jnp.float32).astype(h.dtype)
+        scores = jnp.where(enc_mask, scores, -1e9)
+        alpha = jax.nn.softmax(scores, axis=-1)  # [B, S]
+        return jnp.einsum("bs,bsc->bc", alpha, enc)
+    # beam case [B, K, H]
+    t = jnp.tanh(enc_proj[:, None] + dec_proj[:, :, None, :])  # [B, K, S, A]
+    scores = jnp.dot(t, v_att, preferred_element_type=jnp.float32).astype(h.dtype)
+    scores = jnp.where(enc_mask[:, None], scores, -1e9)
+    alpha = jax.nn.softmax(scores, axis=-1)  # [B, K, S]
+    return jnp.einsum("bks,bsc->bkc", alpha, enc)
+
+
+@register_op("attention_gru_decoder")
+def attention_gru_decoder_kernel(ctx):
+    """Training-time attention decoder (teacher forcing).
+
+    Inputs:
+      EncState  LoDArray [.., C]   encoder outputs over source tokens
+      TrgEmb    LoDArray [.., E]   target-side input embeddings
+      H0        [B, H]             decoder boot state
+      WaEnc [C, A], WaDec [H, A], Va [A]        attention params
+      Wx [(E+C), 3H], Wh [H, 3H], Bias [3H]     GRU params
+    Output: Hidden LoDArray [.., H] aligned with TrgEmb's lod.
+    """
+    enc_l: LoDArray = ctx.input("EncState")
+    trg_l: LoDArray = ctx.input("TrgEmb")
+    h0 = ctx.input("H0")
+    wa_enc, wa_dec, v_att = ctx.input("WaEnc"), ctx.input("WaDec"), ctx.input("Va")
+    wx, wh = ctx.input("Wx"), ctx.input("Wh")
+    bias = ctx.input("Bias") if ctx.has_input("Bias") else None
+
+    src_len = ctx.attr("src_max_len") or enc_l.capacity
+    trg_len = ctx.attr("trg_max_len") or trg_l.capacity
+    enc_b, enc_mask = enc_l.to_batch(max_len=src_len, time_major=False)  # [B,S,C]
+    trg_b, trg_mask = trg_l.to_batch(max_len=trg_len)  # [T,B,E]
+    enc_proj = jnp.dot(
+        enc_b, wa_enc, preferred_element_type=jnp.float32
+    ).astype(enc_b.dtype)  # [B, S, A]
+
+    def step(h_prev, inp):
+        x_t, m_t = inp  # [B, E], [B]
+        ctxv = _attention(h_prev, enc_b, enc_proj, enc_mask, wa_dec, v_att)
+        xin = jnp.concatenate([x_t, ctxv], axis=-1)  # [B, E+C]
+        xp = jnp.dot(xin, wx, preferred_element_type=jnp.float32).astype(x_t.dtype)
+        if bias is not None:
+            xp = xp + bias
+        h = gru_cell(xp, h_prev, wh, jax.nn.sigmoid, jnp.tanh)
+        m = m_t[:, None].astype(h.dtype)
+        h = m * h + (1 - m) * h_prev
+        return h, h
+
+    _, h_seq = jax.lax.scan(step, h0, (trg_b, trg_mask))
+    ctx.set_output("Hidden", LoDArray.from_batch(h_seq, trg_mask, trg_l))
+
+
+@register_op("attention_gru_beam_search")
+def attention_gru_beam_search_kernel(ctx):
+    """Jitted beam-search generation (reference:
+
+    RecurrentGradientMachine::beamSearch :309 + hl_top_k.cu top-k expand,
+    Fluid beam_search_op.cc). Static [B, K] beam state, `max_len` scan
+    steps, backtrack scan at the end.
+
+    Inputs: EncState (LoDArray), H0, attention+GRU params as in
+    attention_gru_decoder, Embedding [V, E] target table, WOut [H, V],
+    BOut [V]. Attrs: beam_size, max_len, bos_id, eos_id.
+    Outputs: Ids [B, K, T] int32, Scores [B, K] (total log-prob, best
+    first), Lengths [B, K] int32 (tokens before/including EOS).
+    """
+    enc_l: LoDArray = ctx.input("EncState")
+    h0 = ctx.input("H0")  # [B, H]
+    wa_enc, wa_dec, v_att = ctx.input("WaEnc"), ctx.input("WaDec"), ctx.input("Va")
+    wx, wh = ctx.input("Wx"), ctx.input("Wh")
+    bias = ctx.input("Bias") if ctx.has_input("Bias") else None
+    emb = ctx.input("Embedding")  # [V, E]
+    w_out, b_out = ctx.input("WOut"), ctx.input("BOut")
+
+    K = ctx.attr("beam_size", 4)
+    T = ctx.attr("max_len", 32)
+    bos = ctx.attr("bos_id", 0)
+    eos = ctx.attr("eos_id", 1)
+    src_len = ctx.attr("src_max_len") or enc_l.capacity
+    norm_by_len = ctx.attr("length_normalize", False)
+
+    enc_b, enc_mask = enc_l.to_batch(max_len=src_len, time_major=False)
+    enc_proj = jnp.dot(
+        enc_b, wa_enc, preferred_element_type=jnp.float32
+    ).astype(enc_b.dtype)
+    B = enc_b.shape[0]
+    V = emb.shape[0]
+    neg_inf = jnp.asarray(-1e9, enc_b.dtype)
+
+    h_beams = jnp.broadcast_to(h0[:, None], (B, K, h0.shape[-1]))
+    tokens = jnp.full((B, K), bos, jnp.int32)
+    # only beam 0 is live at t=0 so the first expansion isn't K duplicates
+    scores = jnp.where(jnp.arange(K) == 0, 0.0, neg_inf) * jnp.ones((B, 1))
+    scores = scores.astype(enc_b.dtype)
+    finished = jnp.zeros((B, K), bool)
+
+    def step(carry, _):
+        h, tok, sc, fin = carry
+        x = emb[tok]  # [B, K, E]
+        ctxv = _attention(h, enc_b, enc_proj, enc_mask, wa_dec, v_att)
+        xin = jnp.concatenate([x, ctxv], axis=-1)
+        xp = jnp.dot(xin, wx, preferred_element_type=jnp.float32).astype(x.dtype)
+        if bias is not None:
+            xp = xp + bias
+        h_new = gru_cell(xp, h, wh, jax.nn.sigmoid, jnp.tanh)
+        h_new = jnp.where(fin[..., None], h, h_new)
+        logits = jnp.dot(
+            h_new, w_out, preferred_element_type=jnp.float32
+        ).astype(h.dtype) + b_out  # [B, K, V]
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        # finished beams may only emit EOS at zero cost (hypothesis frozen)
+        eos_onehot = (jnp.arange(V) == eos).astype(logp.dtype)
+        logp = jnp.where(fin[..., None], jnp.log(eos_onehot + 1e-30), logp)
+        total = sc[..., None] + logp  # [B, K, V]
+        flat = total.reshape(B, K * V)
+        top_sc, top_idx = jax.lax.top_k(flat, K)  # [B, K]
+        parent = top_idx // V
+        new_tok = (top_idx % V).astype(jnp.int32)
+        h_sel = jnp.take_along_axis(h_new, parent[..., None], axis=1)
+        fin_sel = jnp.take_along_axis(fin, parent, axis=1)
+        new_fin = fin_sel | (new_tok == eos)
+        return (h_sel, new_tok, top_sc, new_fin), (parent, new_tok)
+
+    (_, _, final_scores, _), (parents, toks) = jax.lax.scan(
+        step, (h_beams, tokens, scores, finished), None, length=T
+    )
+    # backtrack the (parent, token) trellis from the last step
+    def back(beam_idx, pt):
+        parent, tok = pt  # [B, K]
+        t = jnp.take_along_axis(tok, beam_idx, axis=1)
+        prev = jnp.take_along_axis(parent, beam_idx, axis=1)
+        return prev, t
+
+    last = jnp.broadcast_to(jnp.arange(K)[None], (B, K))
+    _, ids_rev = jax.lax.scan(back, last, (parents, toks), reverse=True)
+    ids = jnp.moveaxis(ids_rev, 0, -1)  # [B, K, T]
+
+    # lengths: first EOS position + 1 (or T if none)
+    is_eos = ids == eos
+    any_eos = is_eos.any(axis=-1)
+    first_eos = jnp.argmax(is_eos, axis=-1)
+    lengths = jnp.where(any_eos, first_eos + 1, T).astype(jnp.int32)
+    out_scores = final_scores
+    if norm_by_len:
+        out_scores = out_scores / jnp.maximum(lengths, 1).astype(out_scores.dtype)
+        # normalization can reorder hypotheses — re-sort best-first
+        order = jnp.argsort(-out_scores, axis=1)  # [B, K]
+        out_scores = jnp.take_along_axis(out_scores, order, axis=1)
+        ids = jnp.take_along_axis(ids, order[..., None], axis=1)
+        lengths = jnp.take_along_axis(lengths, order, axis=1)
+
+    ctx.set_output("Ids", ids)
+    ctx.set_output("Scores", out_scores)
+    if ctx.has_output("Lengths"):
+        ctx.set_output("Lengths", lengths)

@@ -194,17 +194,28 @@ def cross_entropy_kernel(ctx):
 def softmax_with_cross_entropy_kernel(ctx):
     """Reference: paddle/operators/softmax_with_cross_entropy_op.cc —
 
-    numerically-stable fused version."""
-    logits = ctx.input("Logits")
-    label = ctx.input("Label")
+    numerically-stable fused version. Ragged (LoDArray) logits/labels give
+    a per-token LoD loss with padding slots zeroed (the reference computes
+    token losses over the flat no-padding layout for free)."""
+    logits_in = ctx.input("Logits")
+    label_in = ctx.input("Label")
+    ragged = isinstance(logits_in, LoDArray)
+    logits = logits_in.data if ragged else logits_in
+    label = label_in.data if isinstance(label_in, LoDArray) else label_in
     logp = jax.nn.log_softmax(logits, axis=-1)
     if ctx.attr("soft_label", False):
         loss = -jnp.sum(label * logp, axis=-1, keepdims=True)
     else:
         lbl = label[..., 0] if label.ndim == logits.ndim else label
-        loss = -jnp.take_along_axis(logp, lbl[..., None].astype(jnp.int32), axis=-1)
-    ctx.set_output("Softmax", jnp.exp(logp))
-    ctx.set_output("Loss", loss)
+        lbl = jnp.clip(lbl.astype(jnp.int32), 0, logits.shape[-1] - 1)
+        loss = -jnp.take_along_axis(logp, lbl[..., None], axis=-1)
+    if ragged:
+        loss = jnp.where(logits_in.token_mask[:, None], loss, 0.0)
+        ctx.set_output("Softmax", logits_in.with_data(jnp.exp(logp)))
+        ctx.set_output("Loss", logits_in.with_data(loss))
+    else:
+        ctx.set_output("Softmax", jnp.exp(logp))
+        ctx.set_output("Loss", loss)
 
 
 @register_op("square_error_cost")

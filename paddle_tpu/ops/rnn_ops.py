@@ -90,6 +90,29 @@ def lstm_scan(
     return h_seq, (h_T, c_T)
 
 
+def gru_cell(xp, h_prev, w_rec, ga, da):
+    """One GRU step on a pre-projected (and biased) input xp [..., 3H].
+
+    w_rec packs [H, 2H] update/reset + [H, H] candidate as [H, 3H].
+    Reference: operators/math/detail/gru_kernel.h:62 gru_finalOutput —
+    h = (1-u)*h_prev + u*c. Shared by gru_scan and the attention decoder."""
+    H = h_prev.shape[-1]
+    w_ur, w_c = w_rec[:, : 2 * H], w_rec[:, 2 * H :]
+    x_ur, x_c = xp[..., : 2 * H], xp[..., 2 * H :]
+    ur = ga(
+        x_ur
+        + jnp.dot(h_prev, w_ur, preferred_element_type=jnp.float32).astype(xp.dtype)
+    )
+    u, r = ur[..., :H], ur[..., H:]
+    c = da(
+        x_c
+        + jnp.dot(r * h_prev, w_c, preferred_element_type=jnp.float32).astype(
+            xp.dtype
+        )
+    )
+    return (1 - u) * h_prev + u * c
+
+
 def gru_scan(
     x_tbh,  # [T, B, 3H]
     mask,  # [T, B]
@@ -108,30 +131,11 @@ def gru_scan(
     if reverse:
         x_tbh = x_tbh[::-1]
         mask = mask[::-1]
-    w_ur = w_rec[:, : 2 * H]
-    w_c = w_rec[:, 2 * H :]
-
     def step(h_prev, inp):
         x_t, m_t = inp
         if bias is not None:
             x_t = x_t + bias
-        x_ur, x_c = x_t[:, : 2 * H], x_t[:, 2 * H :]
-        ur = ga(
-            x_ur
-            + jnp.dot(h_prev, w_ur, preferred_element_type=jnp.float32).astype(
-                x_t.dtype
-            )
-        )
-        u, r = ur[:, :H], ur[:, H:]
-        c = da(
-            x_c
-            + jnp.dot(r * h_prev, w_c, preferred_element_type=jnp.float32).astype(
-                x_t.dtype
-            )
-        )
-        # reference gru_finalOutput (operators/math/detail/gru_kernel.h:62):
-        # h = (1-u)*h_prev + u*c
-        h = (1 - u) * h_prev + u * c
+        h = gru_cell(x_t, h_prev, w_rec, ga, da)
         m = m_t[:, None].astype(x_t.dtype)
         h = m * h + (1 - m) * h_prev
         return h, h

@@ -173,8 +173,10 @@ class Trainer:
             skip_until = self._resume_batch
             self._resume_batch = 0  # only the resumed pass skips
             last_batch_id = -1
+            interrupted_mid_pass = False
             for batch_id, data in enumerate(reader()):
                 if self._stop:
+                    interrupted_mid_pass = True
                     break
                 last_batch_id = batch_id
                 if batch_id < skip_until:
@@ -214,9 +216,14 @@ class Trainer:
             cc = self.checkpoint_config
             if self._stop:
                 # interrupted mid-pass: checkpoint must record the batch
-                # position so resume re-enters this pass, not the next one
-                if cc and last_batch_id >= 0:
-                    self._save_checkpoint(pass_id, batch_id=last_batch_id)
+                # position so resume re-enters this pass, not the next one.
+                # A stop() issued from the EndPass handler (canonical v2
+                # early-stop) left the pass COMPLETE — save end-of-pass.
+                if cc:
+                    if interrupted_mid_pass and last_batch_id >= 0:
+                        self._save_checkpoint(pass_id, batch_id=last_batch_id)
+                    else:
+                        self._save_checkpoint(pass_id)
                 break
             if cc and cc.epoch_interval and (pass_id + 1) % cc.epoch_interval == 0:
                 self._save_checkpoint(pass_id)
