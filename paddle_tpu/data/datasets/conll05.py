@@ -72,13 +72,13 @@ def _reader(n, seed):
             labels = [o_tag] * length
             # role span left of the predicate; type from word id parity
             lstart = max(0, pred_pos - 3)
-            t0 = words[lstart] % 2  # A0 or A1
-            labels[lstart] = 2 * t0
+            t0 = verb % 2  # A0 or A1 — keyed to the predicate so the
+            labels[lstart] = 2 * t0  # mapping generalizes to unseen words
             for k in range(lstart + 1, pred_pos):
                 labels[k] = 2 * t0 + 1
             # role span right of the predicate
             rend = min(length, pred_pos + 1 + rng.randint(1, 4))
-            t1 = 2 + words[pred_pos + 1] % 2  # A2 or A3
+            t1 = 2 + (verb >> 1) % 2  # A2 or A3
             labels[pred_pos + 1] = 2 * t1
             for k in range(pred_pos + 2, rend):
                 labels[k] = 2 * t1 + 1

@@ -1,0 +1,66 @@
+"""CRF layer DSL.
+
+Reference: fluid layers linear_chain_crf / crf_decoding (book 07
+label_semantic_roles), Gen-1 CRFLayer.cpp + CRFDecodingLayer.cpp.
+Share the transition parameter between the two by passing the same
+`param_attr` name.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from ..initializer import UniformInitializer
+from .helper import LayerHelper
+
+__all__ = ["linear_chain_crf", "crf_decoding"]
+
+
+def linear_chain_crf(input, label, param_attr=None,
+                     max_len: Optional[int] = None, name=None):
+    """Per-sequence CRF negative log-likelihood [num_seqs, 1].
+
+    `input` — emissions, LoD [*, D]; `label` — LoD int tags. The
+    transition parameter has shape [D+2, D] (row 0 start, row 1 end,
+    rows 2.. transitions — LinearChainCRF.cpp:23-32)."""
+    helper = LayerHelper("linear_chain_crf", name=name)
+    num_tags = int(input.shape[-1])
+    transition = helper.create_parameter(
+        param_attr, (num_tags + 2, num_tags),
+        default_initializer=UniformInitializer(-0.1, 0.1),
+    )
+    out = helper.create_tmp_variable(input.dtype, (-1, 1))
+    helper.append_op(
+        type="linear_chain_crf",
+        inputs={"Emission": [input], "Label": [label], "Transition": [transition]},
+        outputs={"LogLikelihood": [out]},
+        attrs={"max_len": max_len},
+    )
+    return out
+
+
+def crf_decoding(input, param_attr=None, label=None,
+                 max_len: Optional[int] = None, name=None):
+    """Viterbi decode. Without `label`: LoD int32 best tag per token.
+
+    With `label`: LoD 0/1 token correctness (reference crf_decoding_op
+    semantics for evaluation)."""
+    helper = LayerHelper("crf_decoding", name=name)
+    num_tags = int(input.shape[-1])
+    transition = helper.create_parameter(
+        param_attr, (num_tags + 2, num_tags),
+        default_initializer=UniformInitializer(-0.1, 0.1),
+    )
+    out = helper.create_tmp_variable(np.int32, (-1, 1), lod_level=1)
+    inputs = {"Emission": [input], "Transition": [transition]}
+    if label is not None:
+        inputs["Label"] = [label]
+    helper.append_op(
+        type="crf_decoding",
+        inputs=inputs,
+        outputs={"ViterbiPath": [out]},
+        attrs={"max_len": max_len},
+    )
+    return out

@@ -365,8 +365,12 @@ def cross_entropy(input, label, soft_label: bool = False) -> Variable:
 
 def softmax_with_cross_entropy(logits, label, soft_label: bool = False):
     helper = LayerHelper("softmax_with_cross_entropy")
-    softmax_out = helper.create_tmp_variable(logits.dtype, logits.shape)
-    loss = helper.create_tmp_variable(logits.dtype, (logits.shape[0], 1))
+    softmax_out = helper.create_tmp_variable(
+        logits.dtype, logits.shape, lod_level=logits.lod_level
+    )
+    loss = helper.create_tmp_variable(
+        logits.dtype, (logits.shape[0], 1), lod_level=logits.lod_level
+    )
     helper.append_op(
         type="softmax_with_cross_entropy",
         inputs={"Logits": [logits], "Label": [label]},

@@ -18,7 +18,6 @@ from typing import Optional
 import numpy as np
 
 import paddle_tpu.layers as layers
-from ..param_attr import ParamAttr
 
 __all__ = ["seq2seq_attention", "seq2seq_beam_decode"]
 
@@ -111,21 +110,9 @@ def seq2seq_beam_decode(
         boot_src, size=dec_hidden, act="tanh",
         param_attr=f"{name}.boot_w", bias_attr=f"{name}.boot_b",
     )
-    # re-declare the shared tables so they exist in this program
-    import paddle_tpu.layers.helper as _h
-
-    helper = _h.LayerHelper("s2s_decode", name=f"{name}.bind")
-    trg_emb_w = helper.create_parameter(
-        ParamAttr(name=f"{name}.trg_emb"), (trg_vocab, emb_dim)
-    )
-    out_w = helper.create_parameter(
-        ParamAttr(name=f"{name}.out_w"), (dec_hidden, trg_vocab)
-    )
-    out_b = helper.create_parameter(
-        ParamAttr(name=f"{name}.out_b"), (trg_vocab,), is_bias=True
-    )
+    # the shared tables re-bind by name from the trained scope
     return layers.attention_gru_beam_search(
-        enc, boot, trg_emb_w, out_w, out_b,
+        enc, boot, f"{name}.trg_emb", f"{name}.out_w", f"{name}.out_b",
         size=dec_hidden, beam_size=beam_size, max_len=max_len,
         bos_id=bos_id, eos_id=eos_id, src_max_len=src_max_len,
         length_normalize=length_normalize, name=f"{name}.dec",

@@ -1,0 +1,142 @@
+"""Linear-chain CRF: log-likelihood + Viterbi decoding.
+
+Reference: gserver/layers/LinearChainCRF.cpp (forward/backward/decode),
+CRFLayer.cpp / CRFDecodingLayer.cpp, and Fluid's
+operators/linear_chain_crf_op.cc + crf_decoding_op.cc.
+
+Transition parameter layout follows the reference
+(LinearChainCRF.cpp:23-32): shape [D+2, D] where row 0 is the start
+weights a, row 1 the end weights b, rows 2.. the tag→tag transition
+matrix w.
+
+TPU design: the reference runs per-sequence dynamic loops on CPU; here
+the ragged batch converts once to dense [T, B, D] + mask and BOTH the
+forward (logsumexp) recursion and the Viterbi (max/argmax) recursion are
+single `lax.scan`s over time, with per-sequence lengths handled by
+freezing the carry past each end (same masking idiom as the RNN scans).
+The gradient of the log-likelihood comes from jax.grad of the
+logsumexp recursion — replacing LinearChainCRF::backward's hand-written
+forward-backward expectations with autodiff of the forward pass, which
+is mathematically identical.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.lod import LoDArray
+from ..core.registry import register_op
+
+
+def crf_nll(emission_l: LoDArray, label_l: LoDArray, transition, max_len=None):
+    """Per-sequence negative log-likelihood [max_seqs]."""
+    D = emission_l.data.shape[-1]
+    start_w, end_w, trans = transition[0], transition[1], transition[2:]
+    emit_tb, mask = emission_l.to_batch(max_len=max_len)  # [T, B, D], [T, B]
+    lbl = label_l.data
+    if lbl.ndim == 2 and lbl.shape[1] == 1:
+        lbl = lbl[:, 0]
+    lbl_tb, _ = label_l.with_data(lbl.astype(jnp.int32)).to_batch(max_len=max_len)
+    lbl_tb = jnp.clip(lbl_tb, 0, D - 1)
+    T, B, _ = emit_tb.shape
+    lengths = emission_l.lengths  # [B]
+
+    # ---- partition function: alpha recursion, carry frozen past seq end
+    alpha0 = start_w[None, :] + emit_tb[0]  # [B, D]
+
+    def fwd(alpha, inp):
+        e_t, m_t = inp
+        new = (
+            jax.scipy.special.logsumexp(
+                alpha[:, :, None] + trans[None], axis=1
+            )
+            + e_t
+        )
+        alpha = jnp.where(m_t[:, None], new, alpha)
+        return alpha, None
+
+    alpha_T, _ = jax.lax.scan(fwd, alpha0, (emit_tb[1:], mask[1:]))
+    log_z = jax.scipy.special.logsumexp(alpha_T + end_w[None, :], axis=-1)
+
+    # ---- gold path score
+    emit_score = jnp.take_along_axis(emit_tb, lbl_tb[..., None], axis=-1)[..., 0]
+    emit_sum = jnp.sum(jnp.where(mask, emit_score, 0.0), axis=0)  # [B]
+    trans_score = trans[lbl_tb[:-1], lbl_tb[1:]]  # [T-1, B]
+    trans_sum = jnp.sum(jnp.where(mask[1:], trans_score, 0.0), axis=0)
+    first_lbl = lbl_tb[0]
+    last_idx = jnp.clip(lengths - 1, 0, T - 1)
+    last_lbl = jnp.take_along_axis(lbl_tb, last_idx[None, :], axis=0)[0]
+    gold = emit_sum + trans_sum + start_w[first_lbl] + end_w[last_lbl]
+
+    nll = log_z - gold
+    valid = jnp.arange(B) < emission_l.num_seqs
+    return jnp.where(valid, nll, 0.0)
+
+
+def crf_viterbi(emission_l: LoDArray, transition, max_len=None):
+    """Viterbi decode → dense tags [T, B] int32 + the batch mask."""
+    start_w, end_w, trans = transition[0], transition[1], transition[2:]
+    emit_tb, mask = emission_l.to_batch(max_len=max_len)
+    T, B, D = emit_tb.shape
+
+    alpha0 = start_w[None, :] + emit_tb[0]
+
+    def fwd(alpha, inp):
+        e_t, m_t = inp
+        scores = alpha[:, :, None] + trans[None]  # [B, D_prev, D]
+        best_prev = jnp.argmax(scores, axis=1).astype(jnp.int32)  # [B, D]
+        new = jnp.max(scores, axis=1) + e_t
+        alpha_next = jnp.where(m_t[:, None], new, alpha)
+        # frozen steps use identity backpointers so backtracking through
+        # padding preserves the final tag
+        ident = jnp.broadcast_to(jnp.arange(D, dtype=jnp.int32)[None], (B, D))
+        bp = jnp.where(m_t[:, None], best_prev, ident)
+        return alpha_next, bp
+
+    alpha_T, bps = jax.lax.scan(fwd, alpha0, (emit_tb[1:], mask[1:]))
+    last_tag = jnp.argmax(alpha_T + end_w[None, :], axis=-1).astype(jnp.int32)
+
+    def back(tag, bp):
+        prev = jnp.take_along_axis(bp, tag[:, None], axis=1)[:, 0]
+        return prev, tag
+
+    first_tag, tags_rev = jax.lax.scan(back, last_tag, bps, reverse=True)
+    tags = jnp.concatenate([first_tag[None], tags_rev])  # [T, B]
+    return tags, mask
+
+
+@register_op("linear_chain_crf")
+def linear_chain_crf_kernel(ctx):
+    """Outputs LogLikelihood [max_seqs, 1] = NEGATIVE log-likelihood per
+
+    sequence (matching linear_chain_crf_op.cc, whose output is the nll
+    that the book model feeds to mean())."""
+    emission: LoDArray = ctx.input("Emission")
+    label: LoDArray = ctx.input("Label")
+    transition = ctx.input("Transition")
+    nll = crf_nll(emission, label, transition, max_len=ctx.attr("max_len"))
+    ctx.set_output("LogLikelihood", nll[:, None])
+
+
+@register_op("crf_decoding")
+def crf_decoding_kernel(ctx):
+    """Viterbi path (reference: crf_decoding_op.cc). Without Label: the
+
+    decoded tag per token (LoD aligned). With Label: 0/1 correctness per
+    token (the reference's semantics for the eval path)."""
+    emission: LoDArray = ctx.input("Emission")
+    transition = ctx.input("Transition")
+    tags, mask = crf_viterbi(emission, transition, max_len=ctx.attr("max_len"))
+    tags_lod = LoDArray.from_batch(tags[..., None], mask, emission)
+    tags_lod = tags_lod.with_data(tags_lod.data.astype(jnp.int32))
+    if ctx.has_input("Label"):
+        label: LoDArray = ctx.input("Label")
+        lbl = label.data
+        if lbl.ndim == 1:
+            lbl = lbl[:, None]
+        correct = (tags_lod.data == lbl.astype(jnp.int32)).astype(jnp.int32)
+        correct = jnp.where(emission.token_mask[:, None], correct, 0)
+        ctx.set_output("ViterbiPath", emission.with_data(correct))
+    else:
+        ctx.set_output("ViterbiPath", tags_lod)

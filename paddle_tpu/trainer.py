@@ -220,7 +220,9 @@ class Trainer:
                 # A stop() issued from the EndPass handler (canonical v2
                 # early-stop) left the pass COMPLETE — save end-of-pass.
                 if cc:
-                    if interrupted_mid_pass and last_batch_id >= 0:
+                    if interrupted_mid_pass:
+                        # batch_id may be -1 (stopped before the first
+                        # batch): resume then re-enters this pass at 0
                         self._save_checkpoint(pass_id, batch_id=last_batch_id)
                     else:
                         self._save_checkpoint(pass_id)

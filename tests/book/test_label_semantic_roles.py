@@ -1,0 +1,108 @@
+"""Book 07: label_semantic_roles — SRL tagger with a linear-chain CRF.
+
+Reference acceptance test: python/paddle/v2/fluid/tests/book/
+test_label_semantic_roles.py — 8 feature embeddings → stacked bi-LSTM →
+emissions → linear_chain_crf loss, crf_decoding for inference, chunk F1.
+Here: the same feature set over the synthetic conll05 dataset, one
+bi-GRU instead of the 8-layer stack (CI-sized), CRF loss + Viterbi +
+ChunkEvaluator F1.
+"""
+
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu.data import batch
+from paddle_tpu.data.datasets import conll05
+from paddle_tpu.data.feeder import DataFeeder
+from paddle_tpu.evaluator import ChunkEvaluator
+
+WORD_DIM = 16
+HID = 32
+MAX_LEN = 20
+
+
+def db_lstm(feats, word_dict_len, pred_dict_len, label_dict_len):
+    """Slimmed db_lstm (reference book 07): feature embeddings → fc →
+
+    bi-GRU → emission fc."""
+    word, ctx_n2, ctx_n1, ctx_0, ctx_p1, ctx_p2, pred, mark = feats
+    word_feats = [word, ctx_n2, ctx_n1, ctx_0, ctx_p1, ctx_p2]
+    embs = [
+        pt.layers.embedding(w, size=[word_dict_len, WORD_DIM],
+                            param_attr="srl_word_emb")
+        for w in word_feats
+    ]
+    embs.append(pt.layers.embedding(pred, size=[pred_dict_len, WORD_DIM]))
+    embs.append(pt.layers.embedding(mark, size=[2, WORD_DIM]))
+    hidden = pt.layers.fc(embs, size=HID, act="tanh")
+    fwd_in = pt.layers.fc(hidden, size=3 * HID, bias_attr=False)
+    fwd = pt.layers.dynamic_gru(fwd_in, size=HID, max_len=MAX_LEN)
+    bwd_in = pt.layers.fc(hidden, size=3 * HID, bias_attr=False)
+    bwd = pt.layers.dynamic_gru(bwd_in, size=HID, is_reverse=True,
+                                max_len=MAX_LEN)
+    feat = pt.layers.sequence_concat([fwd, bwd])
+    return pt.layers.fc(feat, size=label_dict_len)
+
+
+def test_label_semantic_roles_crf():
+    word_dict, verb_dict, label_dict = conll05.get_dict()
+    n_labels = len(label_dict)
+
+    prog, startup = pt.Program(), pt.Program()
+    startup.random_seed = 5
+    with pt.program_guard(prog, startup):
+        names = ["word", "ctx_n2", "ctx_n1", "ctx_0", "ctx_p1", "ctx_p2",
+                 "pred", "mark"]
+        feats = [pt.layers.data(n, [-1], np.int32, lod_level=1,
+                                append_batch_size=False) for n in names]
+        label = pt.layers.data("label", [-1], np.int32, lod_level=1,
+                               append_batch_size=False)
+        emission = db_lstm(feats, len(word_dict), len(verb_dict), n_labels)
+        crf_cost = pt.layers.linear_chain_crf(emission, label,
+                                              param_attr="srl_crf_w",
+                                              max_len=MAX_LEN)
+        avg_cost = pt.layers.mean(crf_cost)
+        decoded = pt.layers.crf_decoding(emission, param_attr="srl_crf_w",
+                                         max_len=MAX_LEN)
+        pt.optimizer.Adam(learning_rate=0.01).minimize(avg_cost)
+    # evaluation must NOT run the optimizer slice — use the for-test clone
+    # (reference: fluid Program.clone(for_test=True) in every book test)
+    infer_prog = prog.clone(for_test=True)
+
+    exe = pt.Executor()
+    exe.run(startup)
+    feeder = DataFeeder(feats + [label], bucket=512, max_seqs=16)
+    reader = batch(conll05.train(), 16, drop_last=True)
+
+    costs, it = [], 0
+    while it < 320:
+        for data in reader():
+            feed = feeder.feed(data)
+            (c,) = exe.run(prog, feed=feed, fetch_list=[avg_cost])
+            costs.append(float(c))
+            it += 1
+            if it >= 320:
+                break
+    assert np.mean(costs[-5:]) < 0.5 * np.mean(costs[:5]), (
+        f"CRF cost did not drop: {np.mean(costs[:5]):.2f} -> "
+        f"{np.mean(costs[-5:]):.2f}"
+    )
+
+    # chunk F1 with Viterbi decode on held-out data
+    chunk = ChunkEvaluator(num_chunk_types=4, chunk_scheme="iob")
+    test_reader = batch(conll05.test(), 16, drop_last=True)
+    n_batches = 0
+    for data in test_reader():
+        feed = feeder.feed(data)
+        (dec,) = exe.run(infer_prog, feed=feed, fetch_list=[decoded],
+                         return_numpy=False)
+        pred = np.asarray(dec.data)[:, 0]
+        offs = np.concatenate([[0], np.cumsum(np.asarray(dec.lengths))])
+        preds = [pred[offs[i]:offs[i + 1]] for i in range(len(data))]
+        labels = [np.asarray(row[-1]) for row in data]
+        chunk.update(preds, labels)
+        n_batches += 1
+        if n_batches >= 4:
+            break
+    precision, recall, f1 = chunk.eval()
+    assert f1 > 0.7, f"chunk F1 {f1:.3f} (p={precision:.3f} r={recall:.3f})"

@@ -1,0 +1,106 @@
+"""Linear-chain CRF vs brute-force enumeration (the grad-check-style
+
+oracle of SURVEY §4.1 applied to the CRF: reference tests
+gserver/tests/test_LinearChainCRF.cpp compare against naive loops)."""
+
+import itertools
+
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu.core.lod import LoDArray
+from paddle_tpu.ops.crf_ops import crf_nll, crf_viterbi
+
+D = 3
+
+
+def _path_score(emit, labels, transition):
+    start_w, end_w, trans = transition[0], transition[1], transition[2:]
+    s = start_w[labels[0]] + end_w[labels[-1]]
+    s += sum(emit[t, labels[t]] for t in range(len(labels)))
+    s += sum(trans[labels[t - 1], labels[t]] for t in range(1, len(labels)))
+    return s
+
+
+def _brute(emit, transition):
+    T = emit.shape[0]
+    paths = list(itertools.product(range(D), repeat=T))
+    scores = np.array([_path_score(emit, p, transition) for p in paths])
+    log_z = np.logaddexp.reduce(scores)
+    best = paths[int(np.argmax(scores))]
+    return log_z, np.array(best)
+
+
+def test_crf_nll_and_viterbi_match_brute_force():
+    rng = np.random.RandomState(0)
+    lens = [4, 2, 5]
+    emits = [rng.randn(L, D).astype(np.float32) for L in lens]
+    labels = [rng.randint(0, D, (L,)).astype(np.int32) for L in lens]
+    transition = rng.randn(D + 2, D).astype(np.float32) * 0.5
+
+    emission = LoDArray.from_sequences(emits, capacity=16, max_seqs=3)
+    label_l = LoDArray.from_sequences(labels, capacity=16, max_seqs=3)
+
+    nll = np.asarray(crf_nll(emission, label_l, transition))
+    tags, mask = crf_viterbi(emission, transition)
+    tags = np.asarray(tags)
+
+    for i, (e, l) in enumerate(zip(emits, labels)):
+        log_z, best = _brute(e, transition)
+        gold = _path_score(e, l, transition)
+        np.testing.assert_allclose(nll[i], log_z - gold, rtol=1e-4, atol=1e-4)
+        np.testing.assert_array_equal(tags[: lens[i], i], best)
+
+
+def test_crf_layer_gradcheck_converges():
+    """Train emissions+transitions on a deterministic tag pattern; the
+
+    nll must approach 0 (perfectly learnable mapping)."""
+    rng = np.random.RandomState(1)
+    vocab, ntag = 10, D
+
+    def make(n=8):
+        xs, ys = [], []
+        for _ in range(n):
+            L = rng.randint(3, 7)
+            w = rng.randint(0, vocab, (L,)).astype(np.int32)
+            y = (w % ntag).astype(np.int32)  # tag fully determined by word
+            xs.append(w)
+            ys.append(y)
+        return (LoDArray.from_sequences(xs, capacity=64, max_seqs=n),
+                LoDArray.from_sequences(ys, capacity=64, max_seqs=n))
+
+    prog, startup = pt.Program(), pt.Program()
+    startup.random_seed = 3
+    with pt.program_guard(prog, startup):
+        words = pt.layers.data("w", [-1], np.int32, lod_level=1,
+                               append_batch_size=False)
+        label = pt.layers.data("y", [-1], np.int32, lod_level=1,
+                               append_batch_size=False)
+        emb = pt.layers.embedding(words, size=[vocab, 16])
+        emit = pt.layers.fc(emb, size=ntag)
+        nll = pt.layers.linear_chain_crf(emit, label, param_attr="crf_w",
+                                         max_len=8)
+        cost = pt.layers.mean(nll)
+        decoded = pt.layers.crf_decoding(emit, param_attr="crf_w", max_len=8)
+        pt.optimizer.Adam(learning_rate=0.05).minimize(cost)
+    exe = pt.Executor()
+    exe.run(startup)
+    first = None
+    for i in range(60):
+        x, y = make()
+        c, dec = exe.run(prog, feed={"w": x, "y": y},
+                         fetch_list=[cost, decoded])
+        if first is None:
+            first = float(c)
+    assert float(c) < 0.1 * first, f"CRF nll {first} -> {float(c)}"
+
+    # decode accuracy on a fresh batch
+    x, y = make()
+    (dec,) = exe.run(prog, feed={"w": x, "y": y}, fetch_list=[decoded],
+                     return_numpy=False)
+    pred = np.asarray(dec.data)[:, 0]
+    mask = np.asarray(dec.seq_ids) >= 0
+    truth = np.asarray(x.data) % ntag
+    acc = (pred[mask] == truth[mask]).mean()
+    assert acc > 0.95, f"viterbi decode acc {acc}"
