@@ -9,6 +9,7 @@ traced program instead of hand-written grad kernels.
 from . import activation_ops  # noqa: F401
 from . import attention_ops  # noqa: F401
 from . import crf_ops  # noqa: F401
+from . import ctc_ops  # noqa: F401
 from . import math_ops  # noqa: F401
 from . import nn_ops  # noqa: F401
 from . import optimizer_ops  # noqa: F401

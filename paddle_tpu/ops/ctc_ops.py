@@ -1,0 +1,137 @@
+"""CTC (Connectionist Temporal Classification) loss.
+
+Reference: gserver/layers/CTCLayer.cpp + LinearChainCTC.cpp (in-tree
+implementation) and WarpCTCLayer.cpp / cuda/src/hl_warpctc_wrap.cc (the
+warp-ctc binding); Fluid: operators/warpctc_op.cc.
+
+TPU design: the classic alpha recursion over the blank-extended label
+sequence [b, l1, b, l2, …, b], in log space, as one `lax.scan` over time
+with static shapes [T, B, 2L+1]: variable input lengths freeze the alpha
+carry via the batch mask (same idiom as the RNN scans), variable label
+lengths mask the extended positions and pick the per-sequence final
+states by index. Gradients come from jax.grad of the scan — replacing
+warp-ctc's hand-written beta recursion.
+
+Blank id is configurable (attr `blank`, default 0 — warp-ctc layout).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.lod import LoDArray
+from ..core.registry import register_op
+
+_NEG = -1e30
+
+
+def ctc_loss(logits_l: LoDArray, label_l: LoDArray, blank: int = 0,
+             max_len=None, max_label_len=None, log_input: bool = False):
+    """Per-sequence CTC negative log-likelihood [max_seqs].
+
+    logits_l: LoD [*, C] acoustic frames (unnormalized unless log_input);
+    label_l: LoD int tokens (must not contain `blank`)."""
+    logit_tb, in_mask = logits_l.to_batch(max_len=max_len)  # [T, B, C]
+    lbl = label_l.data
+    if lbl.ndim == 2 and lbl.shape[1] == 1:
+        lbl = lbl[:, 0]
+    lbl_tb, _ = label_l.with_data(lbl.astype(jnp.int32)).to_batch(
+        max_len=max_label_len, time_major=False
+    )  # [B, L]
+    B, L = lbl_tb.shape
+    T = logit_tb.shape[0]
+    C = logit_tb.shape[-1]
+    logp = logit_tb if log_input else jax.nn.log_softmax(logit_tb, axis=-1)
+
+    lab_lens = label_l.lengths  # [B]
+    in_lens = logits_l.lengths
+
+    # blank-extended labels ext [B, S], S = 2L+1
+    S = 2 * L + 1
+    ext = jnp.full((B, S), blank, jnp.int32)
+    ext = ext.at[:, 1::2].set(jnp.clip(lbl_tb, 0, C - 1))
+    pos = jnp.arange(S)[None, :]  # [1, S]
+    valid_pos = pos < (2 * lab_lens[:, None] + 1)  # [B, S]
+    # can we skip from s-2 to s? only onto a non-blank that differs from s-2
+    ext_m2 = jnp.pad(ext, ((0, 0), (2, 0)), constant_values=-1)[:, :S]
+    can_skip = (pos % 2 == 1) & (ext != ext_m2)  # odd positions are labels
+
+    def emit(logp_t):  # [B, C] → [B, S] log-prob of each extended symbol
+        return jnp.take_along_axis(logp_t, ext, axis=-1)
+
+    alpha0 = jnp.full((B, S), _NEG)
+    e0 = emit(logp[0])
+    alpha0 = alpha0.at[:, 0].set(e0[:, 0])
+    has_label = lab_lens > 0
+    alpha0 = alpha0.at[:, 1].set(jnp.where(has_label, e0[:, 1], _NEG))
+
+    def step(alpha, inp):
+        logp_t, m_t = inp
+        a_m1 = jnp.pad(alpha, ((0, 0), (1, 0)), constant_values=_NEG)[:, :S]
+        a_m2 = jnp.pad(alpha, ((0, 0), (2, 0)), constant_values=_NEG)[:, :S]
+        acc = jnp.logaddexp(alpha, a_m1)
+        acc = jnp.where(can_skip, jnp.logaddexp(acc, a_m2), acc)
+        new = acc + emit(logp_t)
+        new = jnp.where(valid_pos, new, _NEG)
+        alpha = jnp.where(m_t[:, None], new, alpha)
+        return alpha, None
+
+    alpha_T, _ = jax.lax.scan(step, alpha0, (logp[1:], in_mask[1:]))
+
+    # loss = -log(alpha[2*lab_len] + alpha[2*lab_len - 1])
+    s_last = 2 * lab_lens  # [B] (blank after last label)
+    a_end = jnp.take_along_axis(alpha_T, s_last[:, None], axis=1)[:, 0]
+    s_prev = jnp.clip(2 * lab_lens - 1, 0, S - 1)
+    a_prev = jnp.take_along_axis(alpha_T, s_prev[:, None], axis=1)[:, 0]
+    a_prev = jnp.where(has_label, a_prev, _NEG)
+    nll = -jnp.logaddexp(a_end, a_prev)
+    valid = (jnp.arange(B) < logits_l.num_seqs) & (in_lens > 0)
+    return jnp.where(valid, nll, 0.0)
+
+
+@register_op("ctc_greedy_decoder")
+def ctc_greedy_decoder_kernel(ctx):
+    """Best-path decode: per-frame argmax, collapse repeats, drop blanks.
+
+    Reference: operators/ctc_align_op.cc (CTCAlign) / the decode path of
+    CTCErrorEvaluator.cpp. Outputs dense Ids [B, T] int32 (padded with
+    -1) and Lengths [B]; static shapes, collapse via keep-mask + cumsum
+    scatter."""
+    logits: LoDArray = ctx.input("Logits")
+    blank = ctx.attr("blank", 0)
+    logit_tb, mask = logits.to_batch(max_len=ctx.attr("max_len"))  # [T,B,C]
+    pred = jnp.argmax(logit_tb, axis=-1).astype(jnp.int32)  # [T, B]
+    prev = jnp.pad(pred, ((1, 0), (0, 0)), constant_values=-1)[:-1]
+    keep = (pred != blank) & (pred != prev) & mask  # [T, B]
+    T, B = pred.shape
+    # output slot per kept frame: exclusive cumsum of keep along time
+    slot = jnp.cumsum(keep.astype(jnp.int32), axis=0) - keep.astype(jnp.int32)
+    slot = jnp.where(keep, slot, T)  # dump dropped frames past the end
+    out = jnp.full((B, T + 1), -1, jnp.int32)
+    out = out.at[jnp.arange(B)[None, :], slot].set(
+        jnp.where(keep, pred, -1)
+    )[:, :T]
+    lengths = jnp.sum(keep, axis=0).astype(jnp.int32)
+    ctx.set_output("Ids", out)
+    ctx.set_output("Lengths", lengths)
+
+
+@register_op("warpctc")
+def warpctc_kernel(ctx):
+    """Reference: operators/warpctc_op.cc / WarpCTCLayer.cpp. Outputs the
+
+    per-sequence loss [max_seqs, 1]; norm_by_times divides by the input
+    length (the reference flag)."""
+    logits: LoDArray = ctx.input("Logits")
+    label: LoDArray = ctx.input("Label")
+    nll = ctc_loss(
+        logits,
+        label,
+        blank=ctx.attr("blank", 0),
+        max_len=ctx.attr("max_len"),
+        max_label_len=ctx.attr("max_label_len"),
+    )
+    if ctx.attr("norm_by_times", False):
+        nll = nll / jnp.maximum(logits.lengths, 1).astype(nll.dtype)
+    ctx.set_output("Loss", nll[:, None])
