@@ -1,0 +1,226 @@
+// Fault-tolerant task-dispatch master.
+//
+// Reference: go/master/service.go — dataset partitioned into tasks
+// (:106), three-queue lifecycle Todo/Pending/Done/Failed (:81-84),
+// pending-task timeout + failure-count eviction (:313-355), snapshot
+// for crash recovery (:166-230). The etcd snapshot becomes a local
+// file (single-coordinator deployment); the RPC surface becomes a C
+// ABI driven through ctypes by the trainer's reader — multi-host
+// trainers would front this with a socket server, the queue semantics
+// are identical.
+
+#include <chrono>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <deque>
+#include <mutex>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+double now_s() {
+  return std::chrono::duration<double>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
+}
+
+struct Task {
+  int64_t id;
+  std::string meta;  // opaque (e.g. "path:chunk_idx")
+  int fail_count = 0;
+};
+
+struct Master {
+  std::mutex mu;
+  std::deque<Task> todo;
+  std::unordered_map<int64_t, Task> pending;  // id → task
+  std::unordered_map<int64_t, double> deadline;
+  std::vector<Task> done;
+  std::vector<Task> failed;  // evicted (fail_count exceeded)
+  int64_t next_id = 0;
+  double timeout_s;
+  int max_failures;
+  std::string snapshot_path;
+
+  void requeue_timed_out() {  // caller holds mu
+    double t = now_s();
+    std::vector<int64_t> expired;
+    for (auto& kv : deadline)
+      if (kv.second <= t) expired.push_back(kv.first);
+    for (int64_t id : expired) {
+      Task task = pending[id];
+      pending.erase(id);
+      deadline.erase(id);
+      task.fail_count++;
+      if (task.fail_count > max_failures)
+        failed.push_back(task);
+      else
+        todo.push_back(task);
+    }
+  }
+
+  bool snapshot() {  // caller holds mu
+    if (snapshot_path.empty()) return true;
+    std::string tmp = snapshot_path + ".tmp";
+    FILE* f = fopen(tmp.c_str(), "wb");
+    if (!f) return false;
+    bool ok = true;
+    auto put = [&](const Task& t, char state) {
+      uint32_t len = t.meta.size();
+      ok = ok && fwrite(&state, 1, 1, f) == 1 && fwrite(&t.id, 8, 1, f) == 1 &&
+           fwrite(&t.fail_count, 4, 1, f) == 1 && fwrite(&len, 4, 1, f) == 1 &&
+           (len == 0 || fwrite(t.meta.data(), 1, len, f) == len);
+    };
+    ok = fwrite(&next_id, 8, 1, f) == 1;
+    // pending counts as todo on recovery (the worker may have died)
+    for (auto& t : todo) put(t, 'T');
+    for (auto& kv : pending) put(kv.second, 'T');
+    for (auto& t : done) put(t, 'D');
+    for (auto& t : failed) put(t, 'F');
+    ok = fclose(f) == 0 && ok;
+    if (!ok) {  // never clobber the last good snapshot with a partial one
+      remove(tmp.c_str());
+      return false;
+    }
+    return rename(tmp.c_str(), snapshot_path.c_str()) == 0;
+  }
+
+  bool recover() {
+    FILE* f = fopen(snapshot_path.c_str(), "rb");
+    if (!f) return false;
+    if (fread(&next_id, 8, 1, f) != 1) {
+      fclose(f);
+      return false;
+    }
+    char state;
+    while (fread(&state, 1, 1, f) == 1) {
+      Task t;
+      uint32_t len;
+      if (fread(&t.id, 8, 1, f) != 1 || fread(&t.fail_count, 4, 1, f) != 1 ||
+          fread(&len, 4, 1, f) != 1)
+        break;
+      t.meta.resize(len);
+      if (len && fread(&t.meta[0], 1, len, f) != len) break;
+      if (state == 'T')
+        todo.push_back(t);
+      else if (state == 'D')
+        done.push_back(t);
+      else
+        failed.push_back(t);
+    }
+    fclose(f);
+    return true;
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+// Creates the master; recovers state from snapshot_path if the file
+// exists (pass "" to disable snapshots).
+void* master_create(const char* snapshot_path, double timeout_s,
+                    int max_failures) {
+  auto* m = new Master();
+  m->timeout_s = timeout_s;
+  m->max_failures = max_failures;
+  m->snapshot_path = snapshot_path ? snapshot_path : "";
+  if (!m->snapshot_path.empty()) m->recover();
+  return m;
+}
+
+void master_destroy(void* handle) { delete static_cast<Master*>(handle); }
+
+int64_t master_add_task(void* handle, const char* meta, int64_t len) {
+  auto* m = static_cast<Master*>(handle);
+  std::lock_guard<std::mutex> g(m->mu);
+  Task t;
+  t.id = m->next_id++;
+  t.meta.assign(meta, len);
+  m->todo.push_back(t);
+  return t.id;
+}
+
+// Pops a task: copies meta into buf (cap bytes) and its exact length
+// into *meta_len. Returns the task id, -1 if nothing is available
+// (all pending/done), or -2 if the meta does not fit in cap (the task
+// stays in todo).
+int64_t master_get_task(void* handle, char* buf, int64_t cap,
+                        int64_t* meta_len) {
+  auto* m = static_cast<Master*>(handle);
+  std::lock_guard<std::mutex> g(m->mu);
+  m->requeue_timed_out();
+  if (m->todo.empty()) return -1;
+  if (static_cast<int64_t>(m->todo.front().meta.size()) > cap) return -2;
+  Task t = m->todo.front();
+  m->todo.pop_front();
+  *meta_len = t.meta.size();
+  memcpy(buf, t.meta.data(), t.meta.size());
+  m->pending[t.id] = t;
+  m->deadline[t.id] = now_s() + m->timeout_s;
+  return t.id;
+}
+
+int master_task_finished(void* handle, int64_t id) {
+  auto* m = static_cast<Master*>(handle);
+  std::lock_guard<std::mutex> g(m->mu);
+  auto it = m->pending.find(id);
+  if (it == m->pending.end()) return -1;  // late/duplicate report
+  m->done.push_back(it->second);
+  m->pending.erase(it);
+  m->deadline.erase(id);
+  m->snapshot();
+  return 0;
+}
+
+int master_task_failed(void* handle, int64_t id) {
+  auto* m = static_cast<Master*>(handle);
+  std::lock_guard<std::mutex> g(m->mu);
+  auto it = m->pending.find(id);
+  if (it == m->pending.end()) return -1;
+  Task t = it->second;
+  m->pending.erase(it);
+  m->deadline.erase(id);
+  t.fail_count++;
+  if (t.fail_count > m->max_failures)
+    m->failed.push_back(t);
+  else
+    m->todo.push_back(t);
+  m->snapshot();
+  return 0;
+}
+
+// counts: [todo, pending, done, failed]
+void master_counts(void* handle, int64_t* out4) {
+  auto* m = static_cast<Master*>(handle);
+  std::lock_guard<std::mutex> g(m->mu);
+  m->requeue_timed_out();
+  out4[0] = m->todo.size();
+  out4[1] = m->pending.size();
+  out4[2] = m->done.size();
+  out4[3] = m->failed.size();
+}
+
+// End of pass: move done back to todo (go master re-dispatches the
+// dataset every pass; service.go SetDataset per pass).
+void master_new_pass(void* handle) {
+  auto* m = static_cast<Master*>(handle);
+  std::lock_guard<std::mutex> g(m->mu);
+  for (auto& t : m->done) {
+    t.fail_count = 0;
+    m->todo.push_back(t);
+  }
+  m->done.clear();
+  m->snapshot();
+}
+
+int master_snapshot_now(void* handle) {
+  auto* m = static_cast<Master*>(handle);
+  std::lock_guard<std::mutex> g(m->mu);
+  return m->snapshot() ? 0 : -1;
+}
+
+}  // extern "C"

@@ -1,0 +1,130 @@
+// Async double-buffered record prefetcher.
+//
+// Reference: gserver/dataproviders/DataProvider.h:292 — the base
+// DataProvider runs a background thread that keeps a bounded buffer of
+// ready batches ahead of the trainer (double buffering, getNextBatch
+// :328 / asyncLoadBatch :375). Here: N reader threads stream records
+// from recordio shards into a bounded ring; the consumer (the Python
+// feed pipeline) pops byte records and builds device arrays while the
+// disks keep streaming.
+
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <deque>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+extern "C" {
+void* rio_reader_open(const char* path);
+int64_t rio_reader_next(void* handle, const char** out);
+void rio_reader_close(void* handle);
+}
+
+namespace {
+
+struct Prefetcher {
+  std::mutex mu;
+  std::condition_variable not_full, not_empty;
+  std::deque<std::string> queue;
+  size_t capacity;
+  std::vector<std::thread> threads;
+  int live_threads = 0;
+  bool stop = false;
+  std::string error;    // first shard failure (unopenable / corrupt)
+  std::string current;  // last popped record, owned for the caller
+
+  void fail(const std::string& msg) {
+    std::lock_guard<std::mutex> g(mu);
+    if (error.empty()) error = msg;
+  }
+
+  void produce(std::vector<std::string> paths) {
+    for (auto& p : paths) {
+      void* r = rio_reader_open(p.c_str());
+      if (!r) {
+        fail("cannot open " + p);
+        break;
+      }
+      const char* buf;
+      int64_t len;
+      while ((len = rio_reader_next(r, &buf)) >= 0) {
+        std::unique_lock<std::mutex> lk(mu);
+        not_full.wait(lk, [&] { return queue.size() < capacity || stop; });
+        if (stop) {
+          rio_reader_close(r);
+          goto out;
+        }
+        queue.emplace_back(buf, len);
+        not_empty.notify_one();
+      }
+      rio_reader_close(r);
+      if (len == -2) {
+        fail("corrupt recordio file " + p);
+        break;
+      }
+    }
+  out: {
+    std::lock_guard<std::mutex> g(mu);
+    live_threads--;
+    not_empty.notify_all();
+  }
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+// Shards `paths` round-robin over n_threads reader threads; `capacity`
+// bounds the ready-record ring.
+void* prefetch_create(const char** paths, int n_paths, int n_threads,
+                      int capacity) {
+  auto* p = new Prefetcher();
+  p->capacity = capacity > 0 ? capacity : 1024;
+  n_threads = std::max(1, std::min(n_threads, n_paths > 0 ? n_paths : 1));
+  std::vector<std::vector<std::string>> shards(n_threads);
+  for (int i = 0; i < n_paths; i++) shards[i % n_threads].push_back(paths[i]);
+  p->live_threads = n_threads;
+  for (int t = 0; t < n_threads; t++)
+    p->threads.emplace_back(&Prefetcher::produce, p, shards[t]);
+  return p;
+}
+
+// Blocks for the next record; returns its length and sets *out (valid
+// until the next call), -1 when all shards are exhausted cleanly, or
+// -2 if any shard failed (unopenable / corrupt) — after draining the
+// records queued before the failure.
+int64_t prefetch_next(void* handle, const char** out) {
+  auto* p = static_cast<Prefetcher*>(handle);
+  std::unique_lock<std::mutex> lk(p->mu);
+  p->not_empty.wait(lk, [&] { return !p->queue.empty() || p->live_threads == 0; });
+  if (p->queue.empty()) return p->error.empty() ? -1 : -2;
+  p->current = std::move(p->queue.front());
+  p->queue.pop_front();
+  p->not_full.notify_one();
+  *out = p->current.data();
+  return static_cast<int64_t>(p->current.size());
+}
+
+// Returns the first error message ("" if none); valid until destroy.
+const char* prefetch_error(void* handle) {
+  auto* p = static_cast<Prefetcher*>(handle);
+  std::lock_guard<std::mutex> g(p->mu);
+  return p->error.c_str();
+}
+
+void prefetch_destroy(void* handle) {
+  auto* p = static_cast<Prefetcher*>(handle);
+  {
+    std::lock_guard<std::mutex> g(p->mu);
+    p->stop = true;
+    p->not_full.notify_all();
+  }
+  for (auto& t : p->threads) t.join();
+  delete p;
+}
+
+}  // extern "C"

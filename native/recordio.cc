@@ -1,0 +1,166 @@
+// RecordIO: chunked, CRC-checked record file format + reader/writer.
+//
+// Reference: the Go recordio package the master's task dispatch shards
+// over (go/master/service.go:106 partitions record files into chunk
+// tasks) and the CRC-validated checkpoint framing of the Go pserver
+// (go/pserver/service.go:346, WrongChecksum go/pserver/service.go:60).
+//
+// Layout: file := chunk*;
+//   chunk := magic(u32) | num_records(u32) | body_len(u64) | crc32(u32)
+//            | body;  body := (len(u32) | bytes)*
+// Records are opaque byte strings; chunks flush at ~1 MiB so the master
+// can hand out (path, chunk_index) tasks and readers can seek.
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <vector>
+
+namespace {
+
+constexpr uint32_t kMagic = 0x50544352;  // "PTCR"
+constexpr size_t kChunkBytes = 1 << 20;
+
+uint32_t crc_table[256];
+bool crc_init_done = [] {
+  for (uint32_t i = 0; i < 256; i++) {
+    uint32_t c = i;
+    for (int k = 0; k < 8; k++) c = (c & 1) ? 0xEDB88320u ^ (c >> 1) : c >> 1;
+    crc_table[i] = c;
+  }
+  return true;
+}();
+
+uint32_t crc32(const char* buf, size_t len) {
+  uint32_t c = 0xFFFFFFFFu;
+  for (size_t i = 0; i < len; i++)
+    c = crc_table[(c ^ static_cast<uint8_t>(buf[i])) & 0xFF] ^ (c >> 8);
+  return c ^ 0xFFFFFFFFu;
+}
+
+struct Writer {
+  FILE* f = nullptr;
+  std::string body;
+  uint32_t num_records = 0;
+
+  bool flush_chunk() {
+    if (num_records == 0) return true;
+    uint32_t magic = kMagic, n = num_records, crc = crc32(body.data(), body.size());
+    uint64_t blen = body.size();
+    if (fwrite(&magic, 4, 1, f) != 1 || fwrite(&n, 4, 1, f) != 1 ||
+        fwrite(&blen, 8, 1, f) != 1 || fwrite(&crc, 4, 1, f) != 1 ||
+        (blen && fwrite(body.data(), 1, blen, f) != blen))
+      return false;
+    body.clear();
+    num_records = 0;
+    return true;
+  }
+};
+
+struct Reader {
+  FILE* f = nullptr;
+  std::vector<char> body;
+  size_t pos = 0;        // cursor into body
+  uint32_t remaining = 0;  // records left in current chunk
+  std::string last_error;
+
+  bool load_chunk() {
+    uint32_t magic, n, crc;
+    uint64_t blen;
+    if (fread(&magic, 4, 1, f) != 1) return false;  // clean EOF
+    if (magic != kMagic || fread(&n, 4, 1, f) != 1 ||
+        fread(&blen, 8, 1, f) != 1 || fread(&crc, 4, 1, f) != 1 ||
+        blen > (1ull << 31)) {  // bound the alloc: corrupt header, not OOM
+      last_error = "corrupt chunk header";
+      return false;
+    }
+    body.resize(blen);
+    if (blen && fread(body.data(), 1, blen, f) != blen) {
+      last_error = "truncated chunk body";
+      return false;
+    }
+    if (crc32(body.data(), blen) != crc) {
+      last_error = "chunk crc mismatch";
+      return false;
+    }
+    pos = 0;
+    remaining = n;
+    return true;
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+void* rio_writer_open(const char* path) {
+  FILE* f = fopen(path, "wb");
+  if (!f) return nullptr;
+  auto* w = new Writer();
+  w->f = f;
+  return w;
+}
+
+int rio_writer_write(void* handle, const char* buf, uint64_t len) {
+  auto* w = static_cast<Writer*>(handle);
+  uint32_t l32 = static_cast<uint32_t>(len);
+  w->body.append(reinterpret_cast<char*>(&l32), 4);
+  w->body.append(buf, len);
+  w->num_records++;
+  if (w->body.size() >= kChunkBytes) return w->flush_chunk() ? 0 : -1;
+  return 0;
+}
+
+int rio_writer_close(void* handle) {
+  auto* w = static_cast<Writer*>(handle);
+  int rc = w->flush_chunk() ? 0 : -1;
+  fclose(w->f);
+  delete w;
+  return rc;
+}
+
+void* rio_reader_open(const char* path) {
+  FILE* f = fopen(path, "rb");
+  if (!f) return nullptr;
+  auto* r = new Reader();
+  r->f = f;
+  return r;
+}
+
+// Returns record length and sets *out (valid until the next call), or
+// -1 at EOF, -2 on corruption.
+int64_t rio_reader_next(void* handle, const char** out) {
+  auto* r = static_cast<Reader*>(handle);
+  if (r->remaining == 0) {
+    if (!r->load_chunk()) return r->last_error.empty() ? -1 : -2;
+  }
+  if (r->pos + 4 > r->body.size()) return -2;
+  uint32_t len;
+  memcpy(&len, r->body.data() + r->pos, 4);
+  r->pos += 4;
+  if (r->pos + len > r->body.size()) return -2;
+  *out = r->body.data() + r->pos;
+  r->pos += len;
+  r->remaining--;
+  return static_cast<int64_t>(len);
+}
+
+void rio_reader_close(void* handle) {
+  auto* r = static_cast<Reader*>(handle);
+  fclose(r->f);
+  delete r;
+}
+
+int64_t rio_num_records(const char* path) {
+  void* h = rio_reader_open(path);
+  if (!h) return -1;
+  int64_t n = 0;
+  const char* buf;
+  int64_t rc;
+  while ((rc = rio_reader_next(h, &buf)) >= 0) n++;
+  rio_reader_close(h);
+  return rc == -2 ? -1 : n;
+}
+
+}  // extern "C"
