@@ -1,0 +1,178 @@
+// C inference ABI over the framework (capi parity).
+//
+// Reference: paddle/capi — load a merged/deployed model from C and run
+// forward (gradient_machine.h:27-94, examples in capi/examples/). The
+// compute engine here is JAX, so this library embeds CPython — exactly
+// the reference's own embedding trick (TrainerConfigHelper.cpp:58 runs
+// config_parser.py inside the C++ trainer) — and drives
+// paddle_tpu.capi_support.Predictor. The C caller sees only raw
+// buffers; no Python types cross the ABI.
+//
+// Thread-safety: calls are serialized through the GIL.
+
+#include <Python.h>
+
+#include <cstdint>
+#include <cstring>
+#include <string>
+
+namespace {
+
+std::string g_error;
+
+void set_error_from_python() {
+  PyObject *type, *value, *tb;
+  PyErr_Fetch(&type, &value, &tb);
+  if (value) {
+    PyObject* s = PyObject_Str(value);
+    const char* msg = s ? PyUnicode_AsUTF8(s) : nullptr;
+    g_error = msg ? msg : "unknown python error";
+    PyErr_Clear();  // AsUTF8 may set a new error
+    Py_XDECREF(s);
+  } else {
+    g_error = "unknown error";
+  }
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(tb);
+}
+
+struct Predictor {
+  PyObject* obj;  // capi_support.Predictor
+};
+
+bool ensure_python() {
+  if (Py_IsInitialized()) return true;
+  Py_InitializeEx(0);
+  if (!Py_IsInitialized()) return false;
+  // release the GIL the init left held, so any thread (including this
+  // one, via PyGILState_Ensure) can take it later
+  PyEval_SaveThread();
+  return true;
+}
+
+}  // namespace
+
+extern "C" {
+
+const char* pt_last_error() { return g_error.c_str(); }
+
+// model_dir: a save_inference_model directory. Returns NULL on error
+// (see pt_last_error). Honors PYTHONPATH/JAX_PLATFORMS from the env.
+void* pt_predictor_create(const char* model_dir) {
+  if (!ensure_python()) {
+    g_error = "cannot initialize python";
+    return nullptr;
+  }
+  PyGILState_STATE gil = PyGILState_Ensure();
+  void* result = nullptr;
+  PyObject* mod = PyImport_ImportModule("paddle_tpu.capi_support");
+  if (!mod) {
+    set_error_from_python();
+    PyGILState_Release(gil);
+    return nullptr;
+  }
+  PyObject* obj =
+      PyObject_CallMethod(mod, "create", "s", model_dir);
+  Py_DECREF(mod);
+  if (!obj) {
+    set_error_from_python();
+  } else {
+    auto* p = new Predictor();
+    p->obj = obj;
+    result = p;
+  }
+  PyGILState_Release(gil);
+  return result;
+}
+
+int pt_predictor_num_fetch(void* handle) {
+  auto* p = static_cast<Predictor*>(handle);
+  PyGILState_STATE gil = PyGILState_Ensure();
+  PyObject* n = PyObject_CallMethod(p->obj, "num_fetch", nullptr);
+  int out = n ? (int)PyLong_AsLong(n) : -1;
+  Py_XDECREF(n);
+  if (out < 0) set_error_from_python();
+  PyGILState_Release(gil);
+  return out;
+}
+
+// Runs one forward. Feeds: n buffers; feed_shapes is the concatenation
+// of each feed's dims (feed_ndims[i] entries each); dtypes are numpy
+// names ("float32", "int32"). The fetch is copied into out_buf (cap
+// bytes); *out_bytes gets the true size, *out_ndim/out_shape (cap 8)
+// the shape, out_dtype (cap 16, NUL-terminated) the numpy dtype name.
+// Returns 0, or -1 on error, or -2 if out_buf is too small.
+int pt_predictor_run(void* handle, const char** feed_names,
+                     const char** feed_data, const int64_t* feed_bytes,
+                     const int64_t* feed_shapes, const int* feed_ndims,
+                     const char** feed_dtypes, int n_feeds, int fetch_idx,
+                     char* out_buf, int64_t out_cap, int64_t* out_bytes,
+                     int64_t* out_shape, int* out_ndim, char* out_dtype) {
+  auto* p = static_cast<Predictor*>(handle);
+  PyGILState_STATE gil = PyGILState_Ensure();
+  int rc = -1;
+  PyObject *names = PyList_New(n_feeds), *blobs = PyList_New(n_feeds),
+           *shapes = PyList_New(n_feeds), *dtypes = PyList_New(n_feeds);
+  const int64_t* sp = feed_shapes;
+  for (int i = 0; i < n_feeds; i++) {
+    PyList_SetItem(names, i, PyUnicode_FromString(feed_names[i]));
+    PyList_SetItem(blobs, i,
+                   PyBytes_FromStringAndSize(feed_data[i], feed_bytes[i]));
+    PyObject* shp = PyList_New(feed_ndims[i]);
+    for (int d = 0; d < feed_ndims[i]; d++)
+      PyList_SetItem(shp, d, PyLong_FromLongLong(*sp++));
+    PyList_SetItem(shapes, i, shp);
+    PyList_SetItem(dtypes, i, PyUnicode_FromString(feed_dtypes[i]));
+  }
+  PyObject* res = PyObject_CallMethod(p->obj, "run_raw", "OOOOi", names,
+                                      blobs, shapes, dtypes, fetch_idx);
+  Py_DECREF(names);
+  Py_DECREF(blobs);
+  Py_DECREF(shapes);
+  Py_DECREF(dtypes);
+  if (!res) {
+    set_error_from_python();
+    PyGILState_Release(gil);
+    return -1;
+  }
+  PyObject *bytes_obj, *shape_obj, *dtype_obj;
+  if (PyArg_ParseTuple(res, "SOU", &bytes_obj, &shape_obj, &dtype_obj)) {
+    char* buf;
+    Py_ssize_t blen;
+    PyBytes_AsStringAndSize(bytes_obj, &buf, &blen);
+    *out_bytes = blen;
+    int nd = (int)PyList_Size(shape_obj);
+    *out_ndim = nd > 8 ? 8 : nd;
+    for (int d = 0; d < *out_ndim; d++)
+      out_shape[d] = PyLong_AsLongLong(PyList_GetItem(shape_obj, d));
+    if (out_dtype) {
+      const char* dt = PyUnicode_AsUTF8(dtype_obj);
+      snprintf(out_dtype, 16, "%s", dt ? dt : "");
+    }
+    if (blen > out_cap) {
+      rc = -2;
+      g_error = "output buffer too small";
+    } else {
+      memcpy(out_buf, buf, blen);
+      rc = 0;
+    }
+  } else {
+    set_error_from_python();
+  }
+  Py_DECREF(res);
+  PyGILState_Release(gil);
+  return rc;
+}
+
+void pt_predictor_destroy(void* handle) {
+  auto* p = static_cast<Predictor*>(handle);
+  if (Py_IsInitialized()) {
+    PyGILState_STATE gil = PyGILState_Ensure();
+    Py_XDECREF(p->obj);
+    PyGILState_Release(gil);
+  }
+  delete p;
+}
+
+}  // extern "C"

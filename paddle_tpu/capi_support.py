@@ -1,0 +1,58 @@
+"""Support module for the C inference ABI (native/capi.cc).
+
+Reference: paddle/capi — a pure-C surface over the inference runtime
+(gradient_machine.h:27-94). The TPU build's compute engine is JAX, so
+the C library embeds CPython (the same trick the reference trainer uses
+for config parsing — TrainerConfigHelper.cpp:58 runs config_parser.py
+in an embedded interpreter) and drives this module. The C side only
+handles raw byte buffers; everything numpy stays here.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Sequence
+
+import numpy as np
+
+from .core.executor import Executor, Scope
+from .io import load_inference_model
+
+
+class Predictor:
+    def __init__(self, model_dir: str):
+        self.scope = Scope()
+        self.program, self.feed_names, self.fetch_names = (
+            load_inference_model(model_dir, scope=self.scope)
+        )
+        self.exe = Executor()
+
+    def num_fetch(self) -> int:
+        return len(self.fetch_names)
+
+    def run_raw(
+        self,
+        names: Sequence[str],
+        blobs: Sequence[bytes],
+        shapes: Sequence[Sequence[int]],
+        dtypes: Sequence[str],
+        fetch_idx: int,
+    ):
+        """Feeds raw buffers, returns (bytes, shape, dtype_str) of one
+        fetch."""
+        feed: Dict[str, np.ndarray] = {}
+        for name, blob, shape, dt in zip(names, blobs, shapes, dtypes):
+            feed[name] = np.frombuffer(blob, dtype=np.dtype(dt)).reshape(
+                tuple(shape)
+            )
+        outs = self.exe.run(
+            self.program,
+            feed=feed,
+            fetch_list=[self.fetch_names[fetch_idx]],
+            scope=self.scope,
+        )
+        out = np.ascontiguousarray(np.asarray(outs[0]))
+        return out.tobytes(), list(out.shape), out.dtype.name
+
+
+def create(model_dir: str) -> Predictor:
+    return Predictor(model_dir)
