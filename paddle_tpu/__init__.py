@@ -25,6 +25,7 @@ from . import evaluator  # noqa: F401
 from . import initializer  # noqa: F401
 from . import io  # noqa: F401
 from . import layers  # noqa: F401
+from . import networks  # noqa: F401
 from . import optimizer  # noqa: F401
 from . import regularizer  # noqa: F401
 from .core import (  # noqa: F401

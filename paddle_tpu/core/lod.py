@@ -94,6 +94,36 @@ class LoDArray:
             jnp.asarray(len(seqs), dtype=jnp.int32),
         )
 
+    @staticmethod
+    def from_nested_sequences(
+        nested: Sequence[Sequence[np.ndarray]],
+        capacity: Optional[int] = None,
+        max_seqs: Optional[int] = None,
+        bucket: int = 128,
+        dtype=None,
+    ) -> "LoDArray":
+        """Build a 2-level ragged batch (reference: 2-level LoD,
+        lod_tensor.h:44-58 / Argument.subSequenceStartPositions). `nested`
+        is a list of sequences, each a list of [len, ...] sub-sequence
+        arrays. `sub_seq_ids` numbers sub-sequences globally across the
+        batch."""
+        base = LoDArray.from_sequences(
+            [np.concatenate(s, axis=0) for s in nested],
+            capacity=capacity, max_seqs=max_seqs, bucket=bucket, dtype=dtype,
+        )
+        cap = base.capacity
+        sub_ids = np.full((cap,), -1, dtype=np.int32)
+        off = 0
+        g = 0
+        for s in nested:
+            for ss in s:
+                n = int(np.asarray(ss).shape[0])
+                sub_ids[off : off + n] = g
+                off += n
+                g += 1
+        return LoDArray(base.data, base.seq_ids, base.lengths, base.num_seqs,
+                        jnp.asarray(sub_ids))
+
     # -- properties ----------------------------------------------------------
     @property
     def capacity(self) -> int:

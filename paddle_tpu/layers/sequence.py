@@ -25,6 +25,14 @@ __all__ = [
     "sequence_concat",
     "sequence_first_step",
     "sequence_last_step",
+    "sequence_slice",
+    "sequence_reshape",
+    "sequence_reverse",
+    "kmax_seq_score",
+    "sub_nested_seq",
+    "featmap_expand",
+    "eos_id",
+    "sequence_conv",
 ]
 
 
@@ -191,3 +199,110 @@ def sequence_last_step(input, name=None):
         type="sequence_last_step", inputs={"X": [input]}, outputs={"Out": [out]}
     )
     return out
+
+
+# ---------------------------------------------------------------------------
+# Widened sequence set (reference: SequenceSliceLayer, SequenceReshapeLayer,
+# KmaxSeqScoreLayer, SubNestedSequenceLayer, FeatureMapExpandLayer,
+# EosIdCheckLayer, ContextProjection/sequence_conv_op)
+# ---------------------------------------------------------------------------
+def sequence_slice(input, offset, length, name=None):
+    helper = LayerHelper("sequence_slice", name=name)
+    out = helper.create_tmp_variable(input.dtype, input.shape, lod_level=1)
+    helper.append_op(
+        type="sequence_slice",
+        inputs={"X": [input], "Offset": [offset], "Length": [length]},
+        outputs={"Out": [out]},
+    )
+    return out
+
+
+def sequence_reshape(input, new_dim, name=None):
+    helper = LayerHelper("sequence_reshape", name=name)
+    out = helper.create_tmp_variable(input.dtype, (-1, new_dim), lod_level=1)
+    helper.append_op(
+        type="sequence_reshape", inputs={"X": [input]},
+        outputs={"Out": [out]}, attrs={"new_dim": new_dim},
+    )
+    return out
+
+
+def sequence_reverse(input, name=None):
+    helper = LayerHelper("sequence_reverse", name=name)
+    out = helper.create_tmp_variable(input.dtype, input.shape, lod_level=1)
+    helper.append_op(
+        type="sequence_reverse", inputs={"X": [input]}, outputs={"Out": [out]}
+    )
+    return out
+
+
+def kmax_seq_score(input, beam_size=1, name=None):
+    helper = LayerHelper("kmax_seq_score", name=name)
+    out = helper.create_tmp_variable(np.int32, (-1, beam_size))
+    helper.append_op(
+        type="kmax_seq_score", inputs={"X": [input]},
+        outputs={"Out": [out]}, attrs={"beam_size": beam_size},
+    )
+    return out
+
+
+def sub_nested_seq(input, selection, name=None):
+    helper = LayerHelper("sub_nested_seq", name=name)
+    out = helper.create_tmp_variable(input.dtype, input.shape, lod_level=1)
+    helper.append_op(
+        type="sub_nested_seq",
+        inputs={"X": [input], "Selection": [selection]},
+        outputs={"Out": [out]},
+    )
+    return out
+
+
+def featmap_expand(input, num_filters, as_row_vector=True, name=None):
+    helper = LayerHelper("featmap_expand", name=name)
+    d = input.shape[-1]
+    out = helper.create_tmp_variable(input.dtype, (-1, d * num_filters),
+                                     lod_level=1)
+    helper.append_op(
+        type="featmap_expand", inputs={"X": [input]}, outputs={"Out": [out]},
+        attrs={"num_filters": num_filters, "as_row_vector": as_row_vector},
+    )
+    return out
+
+
+def eos_id(input, eos_id, name=None):
+    helper = LayerHelper("eos_id", name=name)
+    out = helper.create_tmp_variable(np.float32, (-1, 1), lod_level=1)
+    helper.append_op(
+        type="eos_id", inputs={"X": [input]}, outputs={"Out": [out]},
+        attrs={"eos_id": eos_id},
+    )
+    return out
+
+
+def sequence_conv(input, num_filters, filter_size=3, filter_stride=1,
+                  context_start=None, padding=True, param_attr=None,
+                  bias_attr=None, act=None, name=None):
+    """Context-window conv over a ragged batch (reference sequence_conv_op /
+    Gen-1 ContextProjection + fc, the text-conv building block)."""
+    assert filter_stride == 1, "reference supports stride 1 only"
+    if padding is not True:
+        raise NotImplementedError(
+            "sequence_conv: only zero-clipped boundary windows (padding=True) "
+            "are implemented; the reference's trainable padding_attr rows "
+            "(sequence_conv_op.cc PaddingData) are not")
+    helper = LayerHelper("sequence_conv", name=name)
+    d = input.shape[-1]
+    w = helper.create_parameter(param_attr, (filter_size * d, num_filters))
+    inputs = {"X": [input], "Filter": [w]}
+    if bias_attr is not False:
+        b = helper.create_parameter(bias_attr, (num_filters,), is_bias=True)
+        inputs["Bias"] = [b]
+    out = helper.create_tmp_variable(input.dtype, (-1, num_filters),
+                                     lod_level=1)
+    helper.append_op(
+        type="sequence_conv", inputs=inputs, outputs={"Out": [out]},
+        attrs={"context_length": filter_size,
+               "context_start": (-(filter_size // 2) if context_start is None
+                                 else context_start)},
+    )
+    return helper.append_activation(out, act)

@@ -1,0 +1,114 @@
+"""Composite network builders.
+
+Reference: python/paddle/trainer_config_helpers/networks.py — pre-assembled
+building blocks (simple_img_conv_pool, img_conv_group, sequence_conv_pool,
+text_conv_pool, simple_lstm, bidirectional_lstm, simple_gru) and fluid
+nets.py (simple_img_conv_pool, img_conv_group, sequence_conv_pool,
+glu, scaled_dot_product_attention).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+from . import layers
+
+__all__ = [
+    "simple_img_conv_pool",
+    "img_conv_group",
+    "sequence_conv_pool",
+    "text_conv_pool",
+    "simple_lstm",
+    "simple_gru",
+    "bidirectional_lstm",
+    "bidirectional_gru",
+    "glu",
+]
+
+
+def simple_img_conv_pool(input, num_filters, filter_size, pool_size,
+                         pool_stride=None, act="relu", pool_type="max",
+                         param_attr=None, bias_attr=None):
+    """conv2d + pool2d (reference networks.py simple_img_conv_pool /
+    fluid nets.py:~27)."""
+    conv = layers.conv2d(
+        input, num_filters=num_filters, filter_size=filter_size, act=act,
+        param_attr=param_attr, bias_attr=bias_attr,
+    )
+    return layers.pool2d(conv, pool_size=pool_size, pool_type=pool_type,
+                         pool_stride=pool_stride or pool_size)
+
+
+def img_conv_group(input, conv_num_filter: Sequence[int], conv_filter_size=3,
+                   conv_act="relu", conv_with_batchnorm=False,
+                   conv_batchnorm_drop_rate=0.0, pool_size=2, pool_stride=2,
+                   pool_type="max", is_test=False):
+    """Stacked conv(+bn+dropout) block followed by one pool — the VGG
+    building block (reference networks.py img_conv_group / fluid nets.py)."""
+    tmp = input
+    n = len(conv_num_filter)
+    for i, nf in enumerate(conv_num_filter):
+        tmp = layers.conv2d(
+            tmp, num_filters=nf, filter_size=conv_filter_size, padding=1,
+            act=None if conv_with_batchnorm else conv_act,
+        )
+        if conv_with_batchnorm:
+            tmp = layers.batch_norm(tmp, act=conv_act, is_test=is_test)
+            if conv_batchnorm_drop_rate and i != n - 1:
+                tmp = layers.dropout(tmp, conv_batchnorm_drop_rate,
+                                     is_test=is_test)
+    return layers.pool2d(tmp, pool_size=pool_size, pool_stride=pool_stride,
+                         pool_type=pool_type)
+
+
+def sequence_conv_pool(input, num_filters, filter_size, act="tanh",
+                       pool_type="max", param_attr=None):
+    """sequence_conv + sequence_pool (reference networks.py
+    sequence_conv_pool — the text-conv recipe)."""
+    conv = layers.sequence_conv(input, num_filters=num_filters,
+                                filter_size=filter_size, act=act,
+                                param_attr=param_attr)
+    return layers.sequence_pool(conv, pool_type)
+
+
+text_conv_pool = sequence_conv_pool
+
+
+def simple_lstm(input, size, reverse=False, act="tanh", gate_act="sigmoid"):
+    """fc projection + dynamic_lstm (reference networks.py simple_lstm:
+    mixed full_matrix_projection feeding lstmemory)."""
+    proj = layers.fc(input, size=size * 4, bias_attr=False)
+    return layers.dynamic_lstm(proj, size=size * 4, is_reverse=reverse,
+                               candidate_activation=act,
+                               gate_activation=gate_act)
+
+
+def simple_gru(input, size, reverse=False, act="tanh", gate_act="sigmoid"):
+    proj = layers.fc(input, size=size * 3, bias_attr=False)
+    return layers.dynamic_gru(proj, size=size, is_reverse=reverse,
+                              candidate_activation=act,
+                              gate_activation=gate_act)
+
+
+def bidirectional_lstm(input, size, return_unit=False, act="tanh"):
+    """Forward + backward simple_lstm (reference networks.py
+    bidirectional_lstm): returns the per-token concat, or the [fwd, bwd]
+    unit outputs unconcatenated when return_unit=True."""
+    fwd = simple_lstm(input, size, reverse=False, act=act)
+    bwd = simple_lstm(input, size, reverse=True, act=act)
+    if return_unit:
+        return [fwd, bwd]
+    return layers.sequence_concat([fwd, bwd])
+
+
+def bidirectional_gru(input, size, act="tanh"):
+    fwd = simple_gru(input, size, reverse=False, act=act)
+    bwd = simple_gru(input, size, reverse=True, act=act)
+    return layers.sequence_concat([fwd, bwd])
+
+
+def glu(input, dim=-1):
+    """Gated linear unit: split in two along dim, a * sigmoid(b) (fluid
+    nets.py glu)."""
+    a, b = layers.split(input, 2, dim=dim)
+    return layers.elementwise_mul(a, layers.sigmoid(b))

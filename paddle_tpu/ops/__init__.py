@@ -8,8 +8,11 @@ traced program instead of hand-written grad kernels.
 
 from . import activation_ops  # noqa: F401
 from . import attention_ops  # noqa: F401
+from . import cost_ops  # noqa: F401
 from . import crf_ops  # noqa: F401
+from . import misc_ops  # noqa: F401
 from . import ctc_ops  # noqa: F401
+from . import detection_ops  # noqa: F401
 from . import math_ops  # noqa: F401
 from . import nn_ops  # noqa: F401
 from . import optimizer_ops  # noqa: F401

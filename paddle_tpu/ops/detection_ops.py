@@ -1,0 +1,260 @@
+"""SSD detection op kernels: prior boxes, multibox loss, detection output.
+
+Reference: paddle/gserver/layers/PriorBox.cpp (prior generation + clip),
+MultiBoxLossLayer.cpp (bipartite-free per-prior matching, hard negative
+mining with neg_pos_ratio, smooth-l1 loc loss + softmax conf loss),
+DetectionOutputLayer.cpp + DetectionUtil.cpp (decode + per-class NMS),
+and the detection config helpers in
+python/paddle/trainer_config_helpers/layers.py.
+
+TPU-static design: ground truth arrives as a padded dense [N, G, 4] box
+tensor + [N, G] labels (label 0 = background = padding slot), instead of the
+reference's ragged LoD input; NMS runs a fixed keep_top_k greedy loop under
+lax.fori_loop with masks — everything static-shaped.
+
+Boxes are corner-form (xmin, ymin, xmax, ymax), normalized to [0, 1].
+Encoding is the SSD center-variance scheme (DetectionUtil.cpp encodeBBox).
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.lod import LoDArray
+from ..core.registry import register_op
+
+
+def _data(x):
+    return x.data if isinstance(x, LoDArray) else x
+
+
+def _corner_to_center(b):
+    w = b[..., 2] - b[..., 0]
+    h = b[..., 3] - b[..., 1]
+    cx = b[..., 0] + 0.5 * w
+    cy = b[..., 1] + 0.5 * h
+    return cx, cy, w, h
+
+
+def iou_matrix(a, b):
+    """Pairwise IoU: a [..., A, 4], b [..., B, 4] → [..., A, B]."""
+    lt = jnp.maximum(a[..., :, None, :2], b[..., None, :, :2])
+    rb = jnp.minimum(a[..., :, None, 2:], b[..., None, :, 2:])
+    wh = jnp.maximum(rb - lt, 0.0)
+    inter = wh[..., 0] * wh[..., 1]
+    area_a = jnp.maximum(a[..., 2] - a[..., 0], 0.0) * jnp.maximum(
+        a[..., 3] - a[..., 1], 0.0
+    )
+    area_b = jnp.maximum(b[..., 2] - b[..., 0], 0.0) * jnp.maximum(
+        b[..., 3] - b[..., 1], 0.0
+    )
+    union = area_a[..., :, None] + area_b[..., None, :] - inter
+    return inter / jnp.maximum(union, 1e-10)
+
+
+def encode_boxes(gt, priors, variances):
+    """SSD center-variance encoding (DetectionUtil.cpp encodeBBox)."""
+    gcx, gcy, gw, gh = _corner_to_center(gt)
+    pcx, pcy, pw, ph = _corner_to_center(priors)
+    tx = (gcx - pcx) / (pw * variances[..., 0])
+    ty = (gcy - pcy) / (ph * variances[..., 1])
+    tw = jnp.log(jnp.maximum(gw / jnp.maximum(pw, 1e-10), 1e-10)) / variances[..., 2]
+    th = jnp.log(jnp.maximum(gh / jnp.maximum(ph, 1e-10), 1e-10)) / variances[..., 3]
+    return jnp.stack([tx, ty, tw, th], axis=-1)
+
+
+def decode_boxes(loc, priors, variances):
+    """Inverse of encode_boxes (DetectionUtil.cpp decodeBBox)."""
+    pcx, pcy, pw, ph = _corner_to_center(priors)
+    cx = pcx + loc[..., 0] * variances[..., 0] * pw
+    cy = pcy + loc[..., 1] * variances[..., 1] * ph
+    w = pw * jnp.exp(loc[..., 2] * variances[..., 2])
+    h = ph * jnp.exp(loc[..., 3] * variances[..., 3])
+    return jnp.stack(
+        [cx - 0.5 * w, cy - 0.5 * h, cx + 0.5 * w, cy + 0.5 * h], axis=-1
+    )
+
+
+def make_prior_boxes(layer_h, layer_w, image_h, image_w, min_sizes, max_sizes,
+                     aspect_ratios, variance, clip=True):
+    """NumPy prior-box table — static per config, computed once at trace time
+    (PriorBox.cpp:84-140 loop nest, including the 1/ar flip and the
+    sqrt(min*max) square prior)."""
+    if max_sizes:
+        # reference PriorBox.cpp init: CHECK_EQ(minSize_.size(), maxSize_.size())
+        if len(max_sizes) != len(min_sizes):
+            raise ValueError(
+                f"max_sizes ({len(max_sizes)}) must match min_sizes "
+                f"({len(min_sizes)}) — PriorBox.cpp pairs them elementwise")
+    ars = [1.0]
+    for ar in aspect_ratios:
+        if abs(ar - 1.0) < 1e-6:
+            continue
+        ars.extend([ar, 1.0 / ar])
+    step_w = image_w / layer_w
+    step_h = image_h / layer_h
+    boxes = []
+    for hh in range(layer_h):
+        for ww in range(layer_w):
+            cx = (ww + 0.5) * step_w
+            cy = (hh + 0.5) * step_h
+            for s, mn in enumerate(min_sizes):
+                for ar in ars:
+                    bw = mn * math.sqrt(ar)
+                    bh = mn / math.sqrt(ar)
+                    boxes.append([(cx - bw / 2) / image_w, (cy - bh / 2) / image_h,
+                                  (cx + bw / 2) / image_w, (cy + bh / 2) / image_h])
+                if max_sizes:
+                    sz = math.sqrt(mn * max_sizes[s])
+                    boxes.append([(cx - sz / 2) / image_w, (cy - sz / 2) / image_h,
+                                  (cx + sz / 2) / image_w, (cy + sz / 2) / image_h])
+    out = np.asarray(boxes, np.float32)
+    if clip:
+        out = np.clip(out, 0.0, 1.0)
+    var = np.tile(np.asarray(variance, np.float32)[None, :], (out.shape[0], 1))
+    return out, var
+
+
+@register_op("prior_box")
+def prior_box_kernel(ctx):
+    x = _data(ctx.input("Input"))
+    img = _data(ctx.input("Image"))
+    boxes, var = make_prior_boxes(
+        x.shape[2], x.shape[3], img.shape[2], img.shape[3],
+        list(ctx.attr("min_sizes")), list(ctx.attr("max_sizes") or []),
+        list(ctx.attr("aspect_ratios")), list(ctx.attr("variances")),
+        ctx.attr("clip", True),
+    )
+    ctx.set_output("Boxes", jnp.asarray(boxes))
+    ctx.set_output("Variances", jnp.asarray(var))
+
+
+@register_op("multibox_loss")
+def multibox_loss_kernel(ctx):
+    """MultiBoxLossLayer.cpp semantics, padded-dense:
+    Loc [N,K,4] or [N,K*4]; Conf [N,K,C]; Priors [K,4]; PriorVar [K,4];
+    GtBox [N,G,4]; GtLabel [N,G] int (0 = background = padding).
+    Per-prior match = argmax IoU over gts, positive if IoU>threshold; conf
+    loss on positives + hardest negatives (neg_pos_ratio)."""
+    loc = _data(ctx.input("Loc"))
+    conf = _data(ctx.input("Conf"))
+    priors = _data(ctx.input("Priors"))
+    pvar = _data(ctx.input("PriorVar"))
+    gt = _data(ctx.input("GtBox"))
+    gtl = _data(ctx.input("GtLabel")).astype(jnp.int32)
+    thresh = ctx.attr("overlap_threshold", 0.5)
+    neg_ratio = ctx.attr("neg_pos_ratio", 3.0)
+    n = gt.shape[0]
+    k = priors.shape[0]
+    loc = loc.reshape(n, k, 4)
+    c = conf.shape[-1] if conf.ndim == 3 else conf.shape[1] // k
+    conf = conf.reshape(n, k, c)
+
+    gt_valid = (gtl > 0).astype(jnp.float32)  # [N, G]
+    iou = iou_matrix(
+        jnp.broadcast_to(priors[None], (n, k, 4)), gt
+    ) * gt_valid[:, None, :]  # [N, K, G]
+    best_gt = jnp.argmax(iou, axis=-1)  # [N, K]
+    best_iou = jnp.max(iou, axis=-1)
+    pos = (best_iou > thresh).astype(jnp.float32)  # [N, K]
+    matched_box = jnp.take_along_axis(gt, best_gt[..., None], axis=1)
+    matched_lbl = jnp.take_along_axis(gtl, best_gt, axis=1)  # [N, K]
+
+    # localization loss (smooth l1 on positives)
+    target = encode_boxes(matched_box, priors[None], pvar[None])
+    d = loc - target
+    a = jnp.abs(d)
+    sl1 = jnp.where(a < 1.0, 0.5 * d * d, a - 0.5).sum(-1)
+    loc_loss = (sl1 * pos).sum(-1)  # [N]
+
+    # confidence loss: softmax CE; target = matched label for pos, 0 for neg
+    tgt = jnp.where(pos > 0, matched_lbl, 0)
+    logp = jax.nn.log_softmax(conf, axis=-1)
+    ce = -jnp.take_along_axis(logp, tgt[..., None], axis=-1)[..., 0]  # [N, K]
+
+    # hard negative mining: keep top (neg_ratio * num_pos) negatives by CE
+    num_pos = pos.sum(-1)  # [N]
+    num_neg = jnp.minimum(neg_ratio * num_pos, float(k))
+    neg_ce = jnp.where(pos > 0, -jnp.inf, ce)
+    order = jnp.argsort(-neg_ce, axis=-1)
+    rank = jnp.argsort(order, axis=-1).astype(jnp.float32)  # rank of each prior
+    neg_sel = (rank < num_neg[:, None]).astype(jnp.float32) * (1.0 - pos)
+    conf_loss = (ce * (pos + neg_sel)).sum(-1)
+
+    denom = jnp.maximum(num_pos, 1.0)
+    ctx.set_output("Out", ((loc_loss + conf_loss) / denom)[:, None])
+
+
+def _nms_loop(boxes, scores, keep_top_k, nms_threshold):
+    """Greedy NMS as a fixed-iteration scan: boxes [M,4], scores [M] →
+    (indices [keep_top_k], valid [keep_top_k])."""
+    m = boxes.shape[0]
+
+    def body(carry, _):
+        alive_scores = carry
+        i = jnp.argmax(alive_scores)
+        best = alive_scores[i]
+        ious = iou_matrix(boxes[i][None], boxes)[0]
+        keep = alive_scores * jnp.where(ious > nms_threshold, 0.0, 1.0)
+        keep = keep.at[i].set(0.0)
+        return keep, (i, best > 0.0)
+
+    _, (idx, valid) = jax.lax.scan(
+        body, scores, None, length=min(keep_top_k, m)
+    )
+    return idx, valid
+
+
+@register_op("detection_output")
+def detection_output_kernel(ctx):
+    """DetectionOutputLayer.cpp: decode + per-class NMS + keep_top_k.
+    Output: dense [N, keep_top_k, 6] rows (label, score, x1, y1, x2, y2);
+    empty slots have label -1 (the reference emits a ragged LoD result —
+    padded-dense is the static TPU equivalent)."""
+    loc = _data(ctx.input("Loc"))
+    conf = _data(ctx.input("Conf"))
+    priors = _data(ctx.input("Priors"))
+    pvar = _data(ctx.input("PriorVar"))
+    conf_thresh = ctx.attr("confidence_threshold", 0.01)
+    nms_thresh = ctx.attr("nms_threshold", 0.45)
+    nms_top_k = ctx.attr("nms_top_k", 400)
+    keep_top_k = ctx.attr("keep_top_k", 200)
+    background_id = ctx.attr("background_id", 0)
+
+    n = conf.shape[0]
+    k = priors.shape[0]
+    loc = loc.reshape(n, k, 4)
+    c = conf.shape[-1] if conf.ndim == 3 else conf.shape[1] // k
+    conf = jax.nn.softmax(conf.reshape(n, k, c), axis=-1)
+    decoded = decode_boxes(loc, priors[None], pvar[None])  # [N, K, 4]
+
+    per_class = min(nms_top_k, k)
+
+    def per_image(boxes, probs):
+        rows = []
+        for cls in range(c):
+            if cls == background_id:
+                continue
+            s = jnp.where(probs[:, cls] > conf_thresh, probs[:, cls], 0.0)
+            idx, valid = _nms_loop(boxes, s, per_class, nms_thresh)
+            sel_boxes = boxes[idx]
+            sel_scores = probs[idx, cls] * valid
+            lab = jnp.where(valid, float(cls), -1.0)
+            rows.append(
+                jnp.concatenate(
+                    [lab[:, None], sel_scores[:, None], sel_boxes], axis=-1
+                )
+            )
+        allrows = jnp.concatenate(rows, axis=0)  # [(C-1)*per_class, 6]
+        order = jnp.argsort(-allrows[:, 1])
+        top = allrows[order[:keep_top_k]]
+        pad = keep_top_k - top.shape[0]
+        if pad > 0:
+            top = jnp.pad(top, ((0, pad), (0, 0)), constant_values=-1.0)
+        return jnp.where(top[:, 1:2] > 0, top, -jnp.ones_like(top))
+
+    ctx.set_output("Out", jax.vmap(per_image)(decoded, conf))

@@ -1,0 +1,534 @@
+"""Tensor-manipulation op kernels widening parity with the reference layer set.
+
+Reference coverage (Gen-1 gserver/layers + Fluid operators):
+  gather/scatter            paddle/operators/{gather_op,scatter_op}.cc
+  one_hot                   paddle/operators/one_hot_op (post-ref; Gen-1 uses
+                            sparse index inputs for the same purpose)
+  pad / crop                gserver/layers/{PadLayer,CropLayer}.cpp,
+                            operators/{pad_op,crop_op}.cc
+  multiplex                 gserver/layers/MultiplexLayer.cpp,
+                            operators/multiplex_op.cc
+  maxout                    gserver/layers/MaxOutLayer.cpp,
+                            operators/math/maxouting.cc
+  prelu                     gserver/layers/PReluLayer.cpp (prelu registry)
+  cos_sim                   gserver/layers/CosSimLayer.cpp (cos),
+                            operators/cos_sim_op.cc
+  dot_prod / out_prod       gserver/layers/{DotProdLayer,OuterProdLayer}.cpp
+  l2_distance / row_l2_norm gserver/layers/{L2DistanceLayer,RowL2NormLayer}.cpp
+  interpolation             gserver/layers/InterpolationLayer.cpp
+  power / scaling           gserver/layers/{PowerLayer,ScalingLayer}.cpp
+  slope_intercept           gserver/layers/SlopeInterceptLayer.cpp
+  sum_to_one_norm           gserver/layers/SumToOneNormLayer.cpp
+  convex_comb               gserver/layers/ConvexCombinationLayer.cpp (cos_vm
+                            family sibling)
+  scale_shift               gserver/layers/ScaleShiftLayer.cpp
+  scale_sub_region          gserver/layers/ScaleSubRegionLayer.cpp
+  bilinear_interp           gserver/layers/BilinearInterpLayer.cpp,
+                            operators/bilinear_interp_op (resize)
+  rotate / switch_order     gserver/layers/{RotateLayer,SwitchOrderLayer}.cpp
+  im2sequence (blockexpand) gserver/layers/BlockExpandLayer.cpp
+  row_conv                  gserver/layers/RowConvLayer.cpp,
+                            operators/row_conv_op.cc (lookahead conv)
+  conv_shift                gserver/layers/ConvShiftLayer.cpp (circular conv)
+  sampling_id               gserver/layers/SamplingIdLayer.cpp
+  factorization_machine     gserver/layers/FactorizationMachineLayer.cpp
+  tensor (bilinear product) gserver/layers/TensorLayer.cpp
+  conv3d / pool3d           gserver/layers/{Conv3DLayer,Pool3DLayer}.cpp
+  roi_pool                  gserver/layers/ROIPoolLayer.cpp
+  spp                       gserver/layers/SpatialPyramidPoolLayer.cpp
+
+All kernels are pure jnp/lax; gradients come from jax.grad over the traced
+program. Gather/scatter stay static-shaped (TPU requirement).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.lod import LoDArray
+from ..core.registry import register_op
+
+
+def _data(x):
+    return x.data if isinstance(x, LoDArray) else x
+
+
+def _like(x, data):
+    return x.with_data(data) if isinstance(x, LoDArray) else data
+
+
+# ------------------------------------------------------- gather / scatter ---
+@register_op("gather")
+def gather_kernel(ctx):
+    x = _data(ctx.input("X"))
+    idx = _data(ctx.input("Index")).reshape(-1).astype(jnp.int32)
+    ctx.set_output("Out", jnp.take(x, idx, axis=0))
+
+
+@register_op("scatter")
+def scatter_kernel(ctx):
+    """Reference scatter_op.cc: Out = X; Out[Index] op= Updates (overwrite or
+    add)."""
+    x = _data(ctx.input("X"))
+    idx = _data(ctx.input("Index")).reshape(-1).astype(jnp.int32)
+    upd = _data(ctx.input("Updates"))
+    if ctx.attr("overwrite", True):
+        out = x.at[idx].set(upd)
+    else:
+        out = x.at[idx].add(upd)
+    ctx.set_output("Out", out)
+
+
+@register_op("one_hot")
+def one_hot_kernel(ctx):
+    x = _data(ctx.input("X")).reshape(-1).astype(jnp.int32)
+    depth = ctx.attr("depth")
+    ctx.set_output("Out", jax.nn.one_hot(x, depth, dtype=jnp.float32))
+
+
+# ------------------------------------------------------------- pad / crop ---
+@register_op("pad")
+def pad_kernel(ctx):
+    """paddings attr: flat [lo0, hi0, lo1, hi1, ...] per the reference."""
+    x = _data(ctx.input("X"))
+    p = ctx.attr("paddings")
+    val = ctx.attr("pad_value", 0.0)
+    cfg = [(int(p[2 * i]), int(p[2 * i + 1])) for i in range(x.ndim)]
+    ctx.set_output("Out", jnp.pad(x, cfg, constant_values=val))
+
+
+@register_op("crop")
+def crop_kernel(ctx):
+    x = _data(ctx.input("X"))
+    offsets = ctx.attr("offsets")
+    shape = ctx.attr("shape")
+    ctx.set_output(
+        "Out", jax.lax.dynamic_slice(x, [int(o) for o in offsets], [int(s) for s in shape])
+    )
+
+
+@register_op("multiplex")
+def multiplex_kernel(ctx):
+    """Row-wise select among N inputs by per-row index."""
+    ids = _data(ctx.input("Ids")).reshape(-1).astype(jnp.int32)
+    xs = jnp.stack([_data(x) for x in ctx.inputs("X")], axis=0)  # [n, rows, d]
+    rows = jnp.arange(xs.shape[1])
+    ctx.set_output("Out", xs[ids, rows])
+
+
+# ------------------------------------------------------- simple transforms --
+@register_op("maxout")
+def maxout_kernel(ctx):
+    """[N, C, H, W] → [N, C/groups, H, W], max over each group of channels."""
+    x = _data(ctx.input("X"))
+    g = ctx.attr("groups")
+    n, c, h, w = x.shape
+    ctx.set_output("Out", x.reshape(n, c // g, g, h, w).max(axis=2))
+
+
+@register_op("prelu")
+def prelu_kernel(ctx):
+    x = _data(ctx.input("X"))
+    alpha = _data(ctx.input("Alpha"))
+    mode = ctx.attr("mode", "all")
+    if mode == "channel" and x.ndim == 4:
+        alpha = alpha.reshape(1, -1, 1, 1)
+    ctx.set_output("Out", jnp.where(x > 0, x, alpha * x))
+
+
+@register_op("cos_sim")
+def cos_sim_kernel(ctx):
+    """Row-wise cosine similarity, scaled (reference CosSimLayer scale)."""
+    x = _data(ctx.input("X"))
+    y = _data(ctx.input("Y"))
+    scale = ctx.attr("scale", 1.0)
+    eps = 1e-8
+    num = jnp.sum(x * y, axis=-1, keepdims=True)
+    den = jnp.linalg.norm(x, axis=-1, keepdims=True) * jnp.linalg.norm(
+        y, axis=-1, keepdims=True
+    )
+    ctx.set_output("Out", _like(ctx.input("X"), scale * num / jnp.maximum(den, eps)))
+
+
+@register_op("dot_prod")
+def dot_prod_kernel(ctx):
+    x, y = _data(ctx.input("X")), _data(ctx.input("Y"))
+    ctx.set_output("Out", _like(ctx.input("X"), jnp.sum(x * y, axis=-1, keepdims=True)))
+
+
+@register_op("out_prod")
+def out_prod_kernel(ctx):
+    x, y = _data(ctx.input("X")), _data(ctx.input("Y"))
+    ctx.set_output("Out", (x[:, :, None] * y[:, None, :]).reshape(x.shape[0], -1))
+
+
+@register_op("l2_distance")
+def l2_distance_kernel(ctx):
+    x, y = _data(ctx.input("X")), _data(ctx.input("Y"))
+    d = x - y
+    ctx.set_output("Out", _like(ctx.input("X"), jnp.sqrt(jnp.sum(d * d, axis=-1, keepdims=True) + 1e-12)))
+
+
+@register_op("row_l2_norm")
+def row_l2_norm_kernel(ctx):
+    x = _data(ctx.input("X"))
+    n = jnp.linalg.norm(x, axis=-1, keepdims=True)
+    ctx.set_output("Out", _like(ctx.input("X"), x / jnp.maximum(n, 1e-12)))
+
+
+@register_op("interpolation")
+def interpolation_kernel(ctx):
+    """out = w*x + (1-w)*y, w a per-row scalar (InterpolationLayer.cpp)."""
+    w = _data(ctx.input("W"))
+    x = _data(ctx.input("X"))
+    y = _data(ctx.input("Y"))
+    w = w.reshape(-1, 1)
+    ctx.set_output("Out", _like(ctx.input("X"), w * x + (1.0 - w) * y))
+
+
+@register_op("power")
+def power_kernel(ctx):
+    """out = x ^ w, w per-row scalar (PowerLayer.cpp)."""
+    w = _data(ctx.input("W")).reshape(-1, 1)
+    x = _data(ctx.input("X"))
+    ctx.set_output("Out", _like(ctx.input("X"), jnp.power(x, w)))
+
+
+@register_op("scaling")
+def scaling_kernel(ctx):
+    """out = w * x row-wise, w per-row scalar (ScalingLayer.cpp)."""
+    w = _data(ctx.input("W")).reshape(-1, 1)
+    x = _data(ctx.input("X"))
+    ctx.set_output("Out", _like(ctx.input("X"), w * x))
+
+
+@register_op("slope_intercept")
+def slope_intercept_kernel(ctx):
+    x = _data(ctx.input("X"))
+    ctx.set_output(
+        "Out", _like(ctx.input("X"), ctx.attr("slope", 1.0) * x + ctx.attr("intercept", 0.0))
+    )
+
+
+@register_op("sum_to_one_norm")
+def sum_to_one_norm_kernel(ctx):
+    x = _data(ctx.input("X"))
+    s = jnp.sum(x, axis=-1, keepdims=True)
+    ctx.set_output("Out", _like(ctx.input("X"), x / jnp.where(jnp.abs(s) < 1e-12, 1.0, s)))
+
+
+@register_op("convex_comb")
+def convex_comb_kernel(ctx):
+    """ConvexCombinationLayer: weights [N, K], X [N, K*D] → sum_k w_k x_k."""
+    w = _data(ctx.input("W"))
+    x = _data(ctx.input("X"))
+    n, k = w.shape
+    d = x.shape[1] // k
+    ctx.set_output("Out", jnp.einsum("nk,nkd->nd", w, x.reshape(n, k, d)))
+
+
+@register_op("scale_shift")
+def scale_shift_kernel(ctx):
+    x = _data(ctx.input("X"))
+    out = x * _data(ctx.input("Scale")).reshape(())
+    if ctx.has_input("Bias"):
+        out = out + _data(ctx.input("Bias")).reshape(())
+    ctx.set_output("Out", out)
+
+
+@register_op("scale_sub_region")
+def scale_sub_region_kernel(ctx):
+    """Scale a [c0:c1, h0:h1, w0:w1] sub-box of NCHW input (1-based incl.
+    indices attr, per reference ScaleSubRegionLayer)."""
+    x = _data(ctx.input("X"))
+    c0, c1, h0, h1, w0, w1 = [int(v) for v in ctx.attr("indices")]
+    scale = ctx.attr("scale", 1.0)
+    mask = np.zeros(x.shape[1:], np.float32)
+    mask[c0 - 1 : c1, h0 - 1 : h1, w0 - 1 : w1] = 1.0
+    m = jnp.asarray(mask)[None]
+    ctx.set_output("Out", x * (1.0 - m) + x * m * scale)
+
+
+@register_op("rotate")
+def rotate_kernel(ctx):
+    """90-degree CCW rotation of the HxW planes (RotateLayer.cpp)."""
+    x = _data(ctx.input("X"))
+    ctx.set_output("Out", jnp.rot90(x, k=1, axes=(-2, -1)))
+
+
+@register_op("switch_order")
+def switch_order_kernel(ctx):
+    """NCHW → NHWC reorder (SwitchOrderLayer.cpp)."""
+    x = _data(ctx.input("X"))
+    ctx.set_output("Out", jnp.transpose(x, (0, 2, 3, 1)))
+
+
+# ---------------------------------------------------------- interpolation ---
+@register_op("bilinear_interp")
+def bilinear_interp_kernel(ctx):
+    """NCHW bilinear resize with align_corners=True semantics, matching the
+    reference BilinearInterpLayer ratio = (in-1)/(out-1)."""
+    x = _data(ctx.input("X"))
+    oh, ow = ctx.attr("out_h"), ctx.attr("out_w")
+    n, c, h, w = x.shape
+    ry = (h - 1) / (oh - 1) if oh > 1 else 0.0
+    rx = (w - 1) / (ow - 1) if ow > 1 else 0.0
+    ys = jnp.arange(oh) * ry
+    xs = jnp.arange(ow) * rx
+    y0 = jnp.floor(ys).astype(jnp.int32)
+    x0 = jnp.floor(xs).astype(jnp.int32)
+    y1 = jnp.minimum(y0 + 1, h - 1)
+    x1 = jnp.minimum(x0 + 1, w - 1)
+    wy = (ys - y0)[None, None, :, None]
+    wx = (xs - x0)[None, None, None, :]
+    g = lambda yy, xx: x[:, :, yy, :][:, :, :, xx]
+    out = (
+        g(y0, x0) * (1 - wy) * (1 - wx)
+        + g(y1, x0) * wy * (1 - wx)
+        + g(y0, x1) * (1 - wy) * wx
+        + g(y1, x1) * wy * wx
+    )
+    ctx.set_output("Out", out)
+
+
+# ------------------------------------------------------------ conv family ---
+@register_op("im2sequence")
+def im2sequence_kernel(ctx):
+    """BlockExpandLayer: extract conv-style patches, one sequence step per
+    patch position (reference gserver/layers/BlockExpandLayer.cpp). Dense
+    output [N, outH*outW, C*kh*kw]."""
+    x = _data(ctx.input("X"))
+    kh, kw = ctx.attr("block_y"), ctx.attr("block_x")
+    sh, sw = ctx.attr("stride_y", 1), ctx.attr("stride_x", 1)
+    ph, pw = ctx.attr("padding_y", 0), ctx.attr("padding_x", 0)
+    patches = jax.lax.conv_general_dilated_patches(
+        x,
+        filter_shape=(kh, kw),
+        window_strides=(sh, sw),
+        padding=[(ph, ph), (pw, pw)],
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+    )  # [N, C*kh*kw, outH, outW]
+    n, ckk, oh, ow = patches.shape
+    ctx.set_output("Out", patches.reshape(n, ckk, oh * ow).transpose(0, 2, 1))
+
+
+@register_op("row_conv")
+def row_conv_kernel(ctx):
+    """Lookahead row convolution (DeepSpeech2): out[t] = sum_{i<k} x[t+i] *
+    w[i], per feature. Dense X: [N, T, D]. LoD X: flat [capacity, D] —
+    the window is masked so it never crosses a sequence boundary
+    (reference RowConvLayer walks each sequence separately)."""
+    x_in = ctx.input("X")
+    w = _data(ctx.input("Filter"))
+    k = w.shape[0]
+    if isinstance(x_in, LoDArray):
+        x = x_in.data  # [capacity, D]
+        ids = x_in.seq_ids
+        cap = x.shape[0]
+        xp = jnp.pad(x, ((0, k - 1), (0, 0)))
+        idp = jnp.pad(ids, (0, k - 1), constant_values=-2)
+        out = jnp.zeros_like(x)
+        for i in range(k):  # k is small + static: unrolled, fuses on VPU
+            same = (idp[i : i + cap] == ids)[:, None].astype(x.dtype)
+            out = out + xp[i : i + cap, :] * same * w[i][None, :]
+        ctx.set_output("Out", x_in.with_data(out))
+        return
+    x = x_in
+    t = x.shape[1]
+    xp = jnp.pad(x, ((0, 0), (0, k - 1), (0, 0)))
+    out = jnp.zeros_like(x)
+    for i in range(k):
+        out = out + xp[:, i : i + t, :] * w[i][None, None, :]
+    ctx.set_output("Out", out)
+
+
+@register_op("conv_shift")
+def conv_shift_kernel(ctx):
+    """Circular convolution (ConvShiftLayer.cpp): X [N,D], Y [N,K] (K odd),
+    out[n,d] = sum_j Y[n,j] * X[n, (d + j - K//2) mod D]."""
+    x = _data(ctx.input("X"))
+    y = _data(ctx.input("Y"))
+    k = y.shape[1]
+    half = k // 2
+    out = jnp.zeros_like(x)
+    for j in range(k):
+        out = out + y[:, j : j + 1] * jnp.roll(x, half - j, axis=1)
+    ctx.set_output("Out", out)
+
+
+# ----------------------------------------------------------------- random ---
+@register_op("sampling_id")
+def sampling_id_kernel(ctx):
+    """Sample one column index per row from a probability matrix."""
+    x = _data(ctx.input("X"))
+    ids = jax.random.categorical(ctx.rng(), jnp.log(jnp.maximum(x, 1e-20)), axis=-1)
+    ctx.set_output("Out", ids.astype(jnp.int32))
+
+
+# --------------------------------------------------------------- factored ---
+@register_op("factorization_machine")
+def factorization_machine_kernel(ctx):
+    """2nd-order FM term: 0.5 * sum((xV)^2 - (x^2)(V^2), axis=1)."""
+    x = _data(ctx.input("X"))
+    v = _data(ctx.input("Factor"))
+    xv = jnp.dot(x, v, preferred_element_type=jnp.float32)
+    x2v2 = jnp.dot(x * x, v * v, preferred_element_type=jnp.float32)
+    ctx.set_output("Out", 0.5 * jnp.sum(xv * xv - x2v2, axis=-1, keepdims=True))
+
+
+@register_op("bilinear_tensor_product")
+def bilinear_tensor_product_kernel(ctx):
+    """TensorLayer: out[n,k] = x[n] @ W_k @ y[n] (+ bias)."""
+    x = _data(ctx.input("X"))
+    y = _data(ctx.input("Y"))
+    w = _data(ctx.input("Weight"))  # [K, Dx, Dy]
+    out = jnp.einsum("nd,kde,ne->nk", x, w, y)
+    if ctx.has_input("Bias"):
+        out = out + _data(ctx.input("Bias")).reshape(1, -1)
+    ctx.set_output("Out", out)
+
+
+@register_op("selective_fc")
+def selective_fc_kernel(ctx):
+    """SelectiveFullyConnectedLayer: fc whose output is masked to a selected
+    subset of columns per row (dense mask form — TPU-static)."""
+    x = _data(ctx.input("X"))
+    w = _data(ctx.input("W"))  # [D, C]
+    out = jnp.dot(x, w, preferred_element_type=jnp.float32)
+    if ctx.has_input("Bias"):
+        out = out + _data(ctx.input("Bias")).reshape(1, -1)
+    if ctx.has_input("Mask"):
+        out = out * _data(ctx.input("Mask"))
+    ctx.set_output("Out", out)
+
+
+# ---------------------------------------------------------------- 3-D ops ---
+@register_op("conv3d")
+def conv3d_kernel(ctx):
+    """Reference: gserver/layers/Conv3DLayer.cpp. NCDHW layout."""
+    x = _data(ctx.input("Input"))
+    w = _data(ctx.input("Filter"))  # [out_c, in_c/groups, kd, kh, kw]
+    stride = tuple(ctx.attr("strides", (1, 1, 1)))
+    pad = tuple(ctx.attr("paddings", (0, 0, 0)))
+    groups = ctx.attr("groups", 1)
+    out = jax.lax.conv_general_dilated(
+        x,
+        w,
+        window_strides=stride,
+        padding=[(p, p) for p in pad],
+        feature_group_count=groups,
+        dimension_numbers=("NCDHW", "OIDHW", "NCDHW"),
+        preferred_element_type=jnp.float32,
+    ).astype(x.dtype)
+    if ctx.has_input("Bias"):
+        out = out + _data(ctx.input("Bias")).reshape((1, -1, 1, 1, 1))
+    ctx.set_output("Output", out)
+
+
+@register_op("pool3d")
+def pool3d_kernel(ctx):
+    x = _data(ctx.input("X"))
+    ptype = ctx.attr("pooling_type", "max")
+    ks = tuple(ctx.attr("ksize"))
+    stride = tuple(ctx.attr("strides", ks))
+    pad = tuple(ctx.attr("paddings", (0, 0, 0)))
+    dims = (1, 1) + ks
+    strides = (1, 1) + stride
+    pads = ((0, 0), (0, 0)) + tuple((p, p) for p in pad)
+    if ptype == "max":
+        out = jax.lax.reduce_window(x, -jnp.inf, jax.lax.max, dims, strides, pads)
+    else:
+        s = jax.lax.reduce_window(x, 0.0, jax.lax.add, dims, strides, pads)
+        cnt = jax.lax.reduce_window(
+            jnp.ones_like(x), 0.0, jax.lax.add, dims, strides, pads
+        )
+        out = s / cnt
+    ctx.set_output("Out", out)
+
+
+# ------------------------------------------------------------- roi  / spp ---
+@register_op("roi_pool")
+def roi_pool_kernel(ctx):
+    """ROIPoolLayer: max-pool each ROI box into a fixed [ph, pw] grid.
+    Rois: [R, 5] = (batch_idx, x1, y1, x2, y2) in input-image coords."""
+    x = _data(ctx.input("X"))  # [N, C, H, W]
+    rois = _data(ctx.input("ROIs"))
+    ph, pw = ctx.attr("pooled_height"), ctx.attr("pooled_width")
+    scale = ctx.attr("spatial_scale", 1.0)
+    n, c, h, w = x.shape
+
+    def pool_one(roi):
+        b = roi[0].astype(jnp.int32)
+        x1 = jnp.round(roi[1] * scale).astype(jnp.int32)
+        y1 = jnp.round(roi[2] * scale).astype(jnp.int32)
+        x2 = jnp.round(roi[3] * scale).astype(jnp.int32)
+        y2 = jnp.round(roi[4] * scale).astype(jnp.int32)
+        rh = jnp.maximum(y2 - y1 + 1, 1)
+        rw = jnp.maximum(x2 - x1 + 1, 1)
+        img = x[b]  # [C, H, W]
+        ys = jnp.arange(h)
+        xs = jnp.arange(w)
+        # floor/ceil per-bin windows — may overlap, exactly as the
+        # reference ROIPoolLayer computes hstart/hend (floor(b*rh/ph),
+        # ceil((b+1)*rh/ph)); membership per (bin, pixel)
+        binr = jnp.arange(ph)
+        binc = jnp.arange(pw)
+        y_start = y1 + (binr * rh) // ph  # [ph]
+        y_end = y1 + -((-(binr + 1) * rh) // ph)
+        x_start = x1 + (binc * rw) // pw
+        x_end = x1 + -((-(binc + 1) * rw) // pw)
+        in_box_y = (ys >= y1) & (ys <= y2)
+        in_box_x = (xs >= x1) & (xs <= x2)
+        onehot_y = (
+            (ys[None, :] >= y_start[:, None])
+            & (ys[None, :] < y_end[:, None])
+            & in_box_y[None, :]
+        ).astype(x.dtype)
+        onehot_x = (
+            (xs[None, :] >= x_start[:, None])
+            & (xs[None, :] < x_end[:, None])
+            & in_box_x[None, :]
+        ).astype(x.dtype)
+        # max over pixels mapped to each bin; mask [ph,pw,1,H,W] + img
+        # [C,H,W] broadcast to [ph,pw,C,H,W]
+        in_bin = onehot_y[:, None, None, :, None] * onehot_x[None, :, None, None, :]
+        masked = img + jnp.where(in_bin > 0, 0.0, -jnp.inf)
+        pooled = jnp.max(masked, axis=(-2, -1))  # [ph, pw, C]
+        # empty bins (ROI smaller than the grid) emit 0, matching the
+        # reference ROIPoolLayer's zero-initialized output buffer
+        pooled = jnp.where(jnp.isfinite(pooled), pooled, 0.0)
+        return pooled.transpose(2, 0, 1)  # [C, ph, pw]
+
+    out = jax.vmap(pool_one)(rois)
+    ctx.set_output("Out", out)
+
+
+@register_op("spp")
+def spp_kernel(ctx):
+    """Spatial pyramid pooling: concat pooled [2^l x 2^l] grids for l <
+    pyramid_height (SpatialPyramidPoolLayer.cpp)."""
+    x = _data(ctx.input("X"))
+    levels = ctx.attr("pyramid_height", 3)
+    ptype = ctx.attr("pooling_type", "max")
+    n, c, h, w = x.shape
+    outs = []
+    for l in range(levels):
+        bins = 2**l
+        # per-bin floor/ceil windows as the reference computes start/end
+        # indices — every bin covers >=1 real pixel, no padding involved
+        def edges(dim):
+            lo = [min((i * dim) // bins, dim - 1) for i in range(bins)]
+            hi = [max(-(-((i + 1) * dim) // bins), lo[i] + 1) for i in range(bins)]
+            return lo, [min(v, dim) if v > lo[i] else lo[i] + 1 for i, v in enumerate(hi)]
+
+        ylo, yhi = edges(h)
+        xlo, xhi = edges(w)
+        for by in range(bins):
+            for bx in range(bins):
+                win = x[:, :, ylo[by] : yhi[by], xlo[bx] : xhi[bx]]
+                outs.append(
+                    win.max(axis=(2, 3)) if ptype == "max" else win.mean(axis=(2, 3))
+                )
+    ctx.set_output("Out", jnp.concatenate(outs, axis=1))

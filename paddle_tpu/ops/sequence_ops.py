@@ -126,3 +126,199 @@ def sequence_first_step_kernel(ctx):
 @register_op("sequence_last_step")
 def sequence_last_step_kernel(ctx):
     ctx.set_output("Out", segment_reduce(ctx.input("X"), "last"))
+
+
+# ---------------------------------------------------------------------------
+# Widened sequence set: slice/reshape/reverse/kmax/sub_nested/featmap/eos/conv
+# Reference: gserver/layers/{SequenceSliceLayer,SequenceReshapeLayer,
+# KmaxSeqScoreLayer,SubNestedSequenceLayer,FeatureMapExpandLayer,
+# EosIdCheckLayer,ContextProjection}.cpp and operators/{sequence_slice_op,
+# sequence_conv_op}.cc.
+# ---------------------------------------------------------------------------
+def _out_seq_structure(new_lengths, capacity):
+    """Build (seq_ids, offsets, total) for a new ragged layout given
+    per-sequence lengths (static capacity)."""
+    new_offsets = jnp.concatenate(
+        [jnp.zeros((1,), jnp.int32), jnp.cumsum(new_lengths).astype(jnp.int32)]
+    )
+    total = new_offsets[-1]
+    pos = jnp.arange(capacity)
+    ids = (pos[:, None] >= new_offsets[None, 1:]).sum(-1).astype(jnp.int32)
+    ids = jnp.where(pos < total, ids, -1)
+    return ids, new_offsets, total
+
+
+@register_op("sequence_slice")
+def sequence_slice_kernel(ctx):
+    """SequenceSliceLayer: take [offset, offset+length) of each sequence."""
+    x = ctx.input("X")
+    off = ctx.input("Offset")
+    length = ctx.input("Length")
+    off = (off.data if isinstance(off, LoDArray) else off).reshape(-1).astype(jnp.int32)
+    length = (length.data if isinstance(length, LoDArray) else length).reshape(-1).astype(jnp.int32)
+
+    def _fit(v):  # pad/trim to the LoD's (possibly bucketed) max_seqs
+        if v.shape[0] < x.max_seqs:
+            return jnp.pad(v, (0, x.max_seqs - v.shape[0]))
+        return v[: x.max_seqs]
+
+    off = _fit(off)
+    length = _fit(length)
+    new_len = jnp.clip(jnp.minimum(length, x.lengths - off), 0, None)
+    new_len = new_len * (jnp.arange(x.max_seqs) < x.num_seqs)
+    ids, new_offsets, _ = _out_seq_structure(new_len, x.capacity)
+    sid = jnp.clip(ids, 0, x.max_seqs - 1)
+    local = jnp.arange(x.capacity) - new_offsets[sid]
+    src = jnp.clip(x.offsets[sid] + off[sid] + local, 0, x.capacity - 1)
+    data = jnp.where(
+        (ids >= 0).reshape((-1,) + (1,) * (x.data.ndim - 1)),
+        x.data[src],
+        0,
+    )
+    ctx.set_output("Out", LoDArray(data, ids, new_len, x.num_seqs))
+
+
+@register_op("sequence_reshape")
+def sequence_reshape_kernel(ctx):
+    """SequenceReshapeLayer: refactor feature dim; seq lengths scale by
+    d/new_dim (reference requires divisibility)."""
+    x = ctx.input("X")
+    new_dim = ctx.attr("new_dim")
+    d = x.data.shape[-1]
+    cap = x.capacity
+    new_cap = cap * d // new_dim
+    data = x.data.reshape(new_cap, new_dim)
+    new_len = (x.lengths * d) // new_dim
+    ids, _, _ = _out_seq_structure(new_len, new_cap)
+    ctx.set_output("Out", LoDArray(data, ids, new_len, x.num_seqs))
+
+
+@register_op("sequence_reverse")
+def sequence_reverse_kernel(ctx):
+    x = ctx.input("X")
+    pos = jnp.arange(x.capacity)
+    sid = jnp.clip(jnp.where(x.seq_ids >= 0, x.seq_ids, 0), 0, x.max_seqs - 1)
+    local = pos - x.offsets[sid]
+    src = jnp.clip(x.offsets[sid] + x.lengths[sid] - 1 - local, 0, x.capacity - 1)
+    data = jnp.where(
+        (x.seq_ids >= 0).reshape((-1,) + (1,) * (x.data.ndim - 1)),
+        x.data[src],
+        0,
+    )
+    ctx.set_output("Out", x.with_data(data))
+
+
+@register_op("kmax_seq_score")
+def kmax_seq_score_kernel(ctx):
+    """KmaxSeqScoreLayer: top-k scores per sequence → within-sequence
+    indices, padded with -1 (dense [max_seqs, k] output)."""
+    x = ctx.input("X")
+    k = ctx.attr("beam_size", 1)
+    scores = x.data.reshape(x.capacity)
+    dense, valid = x.with_data(scores).to_batch(time_major=False)  # [B, T]
+    masked = jnp.where(valid, dense, -jnp.inf)
+    _, idx = jax.lax.top_k(masked, k)
+    in_range = jnp.take_along_axis(valid, idx, axis=-1)
+    ctx.set_output("Out", jnp.where(in_range, idx, -1).astype(jnp.int32))
+
+
+@register_op("sub_nested_seq")
+def sub_nested_seq_kernel(ctx):
+    """SubNestedSequenceLayer: from a nested (2-level) sequence, select
+    sub-sequences by global sub-sequence index; emit a level-1 LoD batch.
+    Selection: dense int [num_sel] (global sub-seq ids, -1 = pad)."""
+    x = ctx.input("X")
+    sel = ctx.input("Selection")
+    sel = (sel.data if isinstance(sel, LoDArray) else sel).reshape(-1).astype(jnp.int32)
+    if x.sub_seq_ids is None:
+        raise ValueError("sub_nested_seq requires a 2-level LoDArray input")
+    n_sel = sel.shape[0]
+    sub_ids = x.sub_seq_ids
+    # per-subsequence lengths/offsets over the flat buffer
+    n_subs = x.capacity  # upper bound on distinct sub ids
+    ones = (sub_ids >= 0).astype(jnp.int32)
+    sub_len = jax.ops.segment_sum(
+        ones, jnp.where(sub_ids >= 0, sub_ids, n_subs), num_segments=n_subs + 1
+    )[:-1]
+    sub_off = jnp.concatenate(
+        [jnp.zeros((1,), jnp.int32), jnp.cumsum(sub_len).astype(jnp.int32)]
+    )
+    sel_valid = sel >= 0
+    sel_safe = jnp.where(sel_valid, sel, 0)
+    new_len = jnp.where(sel_valid, sub_len[sel_safe], 0)
+    ids, new_offsets, _ = _out_seq_structure(new_len, x.capacity)
+    sid = jnp.clip(ids, 0, n_sel - 1)
+    local = jnp.arange(x.capacity) - new_offsets[sid]
+    src = jnp.clip(sub_off[sel_safe[sid]] + local, 0, x.capacity - 1)
+    data = jnp.where(
+        (ids >= 0).reshape((-1,) + (1,) * (x.data.ndim - 1)),
+        x.data[src],
+        0,
+    )
+    num = jnp.sum(sel_valid.astype(jnp.int32))
+    ctx.set_output("Out", LoDArray(data, ids, new_len, num))
+
+
+@register_op("featmap_expand")
+def featmap_expand_kernel(ctx):
+    """FeatureMapExpandLayer: tile each token's feature num_filters times
+    ([cap, D] → [cap, num_filters*D]; as_row_vector=False repeats
+    per-element instead)."""
+    x = ctx.input("X")
+    n = ctx.attr("num_filters")
+    as_row = ctx.attr("as_row_vector", True)
+    d = x.data
+    if as_row:
+        out = jnp.tile(d, (1, n))
+    else:
+        out = jnp.repeat(d, n, axis=-1)
+    ctx.set_output("Out", x.with_data(out))
+
+
+@register_op("eos_id")
+def eos_id_kernel(ctx):
+    """EosIdCheckLayer: 1 where the token id equals eos_id."""
+    x = ctx.input("X")
+    eos = ctx.attr("eos_id")
+    d = x.data if isinstance(x, LoDArray) else x
+    out = (d.reshape(d.shape[0], -1)[:, :1] == eos).astype(jnp.float32)
+    if isinstance(x, LoDArray):
+        ctx.set_output("Out", x.with_data(out))
+    else:
+        ctx.set_output("Out", out)
+
+
+@register_op("sequence_conv")
+def sequence_conv_kernel(ctx):
+    """Context-window convolution over a ragged batch: out[t] =
+    concat_{i<L} x[t + start + i] @ Filter, windows clipped at sequence
+    boundaries (reference ContextProjection + sequence_conv_op.cc; the SRL
+    and text-conv models build on this)."""
+    x = ctx.input("X")
+    w = ctx.input("Filter")
+    w = w.data if isinstance(w, LoDArray) else w
+    length = ctx.attr("context_length")
+    start = ctx.attr("context_start", -(length // 2))
+    cap = x.capacity
+    d = x.data
+    pos = jnp.arange(cap)
+    cols = []
+    for i in range(length):
+        shift = start + i
+        src = jnp.clip(pos + shift, 0, cap - 1)
+        same = jnp.where(
+            (pos + shift >= 0) & (pos + shift < cap),
+            x.seq_ids[src] == x.seq_ids,
+            False,
+        )
+        cols.append(
+            jnp.where(same.reshape(-1, 1), d[src], 0.0)
+        )
+    ctx_feat = jnp.concatenate(cols, axis=-1)  # [cap, L*D]
+    out = jnp.dot(ctx_feat, w, preferred_element_type=jnp.float32)
+    if ctx.has_input("Bias"):
+        b = ctx.input("Bias")
+        out = out + (b.data if isinstance(b, LoDArray) else b).reshape(1, -1)
+    # keep padding slots zero (the buffer-wide invariant all LoD ops hold)
+    out = jnp.where(x.token_mask.reshape(-1, 1), out, 0.0)
+    ctx.set_output("Out", x.with_data(out))

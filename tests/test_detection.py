@@ -1,0 +1,183 @@
+"""SSD detection family tests.
+
+Reference analogues: gserver/tests/test_PriorBox.cpp,
+test_DetectionOutput.cpp, and the MultiBoxLoss cases in
+test_LayerGrad.cpp. Prior boxes checked against a direct reimplementation
+of the PriorBox.cpp loop; detection_output checked to decode and NMS an
+obvious box; multibox_loss checked to be trainable (loss decreases as
+predictions approach encoded targets).
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.ops.detection_ops import (
+    decode_boxes,
+    encode_boxes,
+    iou_matrix,
+    make_prior_boxes,
+)
+
+
+def test_prior_box_matches_reference_loop():
+    boxes, var = make_prior_boxes(
+        layer_h=2, layer_w=2, image_h=32, image_w=32,
+        min_sizes=[8.0], max_sizes=[16.0], aspect_ratios=[2.0],
+        variance=[0.1, 0.1, 0.2, 0.2],
+    )
+    # ars = [1, 2, 0.5] → 3 + 1 max-size square = 4 priors per location
+    assert boxes.shape == (2 * 2 * 4, 4)
+    # first prior: center (8,8)/32=0.25, min_size 8 square → 8/32=0.25 wide
+    np.testing.assert_allclose(
+        boxes[0], [0.25 - 0.125, 0.25 - 0.125, 0.25 + 0.125, 0.25 + 0.125],
+        rtol=1e-6,
+    )
+    # second prior: ar=2 → w=8*sqrt2, h=8/sqrt2
+    w = 8 * np.sqrt(2) / 32 / 2
+    h = 8 / np.sqrt(2) / 32 / 2
+    np.testing.assert_allclose(
+        boxes[1], [0.25 - w, 0.25 - h, 0.25 + w, 0.25 + h], rtol=1e-6
+    )
+    # max-size square prior is the last of the 4: sqrt(8*16)
+    s = np.sqrt(8 * 16.0) / 32 / 2
+    np.testing.assert_allclose(
+        boxes[3], [0.25 - s, 0.25 - s, 0.25 + s, 0.25 + s], rtol=1e-6
+    )
+    assert (boxes >= 0).all() and (boxes <= 1).all()  # clipped
+    np.testing.assert_allclose(var, np.tile([[0.1, 0.1, 0.2, 0.2]], (16, 1)))
+
+
+def test_prior_box_layer():
+    feat = pt.layers.data("feat", shape=[4, 3, 3])
+    img = pt.layers.data("img", shape=[3, 24, 24])
+    boxes, var = pt.layers.prior_box(
+        feat, img, min_sizes=[6.0], aspect_ratios=[1.0],
+        variances=[0.1, 0.1, 0.2, 0.2],
+    )
+    exe = pt.Executor()
+    bv, vv = exe.run(
+        feed={"feat": np.zeros((1, 4, 3, 3), np.float32),
+              "img": np.zeros((1, 3, 24, 24), np.float32)},
+        fetch_list=[boxes, var],
+    )
+    assert bv.shape == (9, 4) and vv.shape == (9, 4)
+
+
+def test_encode_decode_roundtrip():
+    import jax.numpy as jnp
+
+    rng = np.random.RandomState(0)
+    priors = np.array([[0.1, 0.1, 0.4, 0.5], [0.3, 0.3, 0.9, 0.8]], np.float32)
+    var = np.tile([[0.1, 0.1, 0.2, 0.2]], (2, 1)).astype(np.float32)
+    gt = np.array([[0.15, 0.12, 0.45, 0.52], [0.25, 0.35, 0.85, 0.75]],
+                  np.float32)
+    enc = encode_boxes(jnp.asarray(gt), jnp.asarray(priors), jnp.asarray(var))
+    dec = decode_boxes(enc, jnp.asarray(priors), jnp.asarray(var))
+    np.testing.assert_allclose(np.asarray(dec), gt, rtol=1e-5, atol=1e-6)
+
+
+def test_iou_matrix():
+    import jax.numpy as jnp
+
+    a = jnp.asarray([[0.0, 0.0, 1.0, 1.0]])
+    b = jnp.asarray([[0.0, 0.0, 1.0, 1.0], [0.5, 0.5, 1.5, 1.5],
+                     [2.0, 2.0, 3.0, 3.0]])
+    m = np.asarray(iou_matrix(a, b))
+    np.testing.assert_allclose(m[0], [1.0, 0.25 / 1.75, 0.0], rtol=1e-5)
+
+
+def test_detection_output_recovers_box():
+    k = 4
+    priors_np = np.array(
+        [[0.0, 0.0, 0.2, 0.2], [0.4, 0.4, 0.6, 0.6], [0.7, 0.7, 0.9, 0.9],
+         [0.1, 0.6, 0.3, 0.9]], np.float32)
+    var_np = np.tile([[0.1, 0.1, 0.2, 0.2]], (k, 1)).astype(np.float32)
+
+    loc = pt.layers.data("loc", shape=[k, 4])
+    conf = pt.layers.data("conf", shape=[k, 3])
+    priors = pt.layers.data("priors", shape=[4], append_batch_size=True)
+    pvar = pt.layers.data("pvar", shape=[4], append_batch_size=True)
+    det = pt.layers.detection_output(loc, conf, priors, pvar,
+                                     confidence_threshold=0.3, keep_top_k=5)
+    exe = pt.Executor()
+    # zero loc offsets → decoded boxes == priors; prior 1 is class 1, hot
+    locv = np.zeros((1, k, 4), np.float32)
+    confv = np.full((1, k, 3), -5.0, np.float32)
+    confv[0, 1, 1] = 5.0  # prior 1 strongly class 1
+    confv[0, :, 0] = 2.0  # background elsewhere
+    confv[0, 1, 0] = -5.0
+    (out,) = exe.run(
+        feed={"loc": locv, "conf": confv, "priors": priors_np, "pvar": var_np},
+        fetch_list=[det],
+    )
+    assert out.shape == (1, 5, 6)
+    top = out[0, 0]
+    assert top[0] == 1.0  # class label
+    assert top[1] > 0.9  # confidence
+    np.testing.assert_allclose(top[2:], priors_np[1], atol=1e-5)
+    # remaining slots empty
+    assert (out[0, 1:, 0] == -1).all()
+
+
+def test_detection_output_nms_suppresses_overlaps():
+    k = 3
+    priors_np = np.array(
+        [[0.1, 0.1, 0.5, 0.5], [0.12, 0.12, 0.52, 0.52],
+         [0.6, 0.6, 0.9, 0.9]], np.float32)
+    var_np = np.tile([[0.1, 0.1, 0.2, 0.2]], (k, 1)).astype(np.float32)
+    loc = pt.layers.data("loc", shape=[k, 4])
+    conf = pt.layers.data("conf", shape=[k, 2])
+    priors = pt.layers.data("priors", shape=[4], append_batch_size=True)
+    pvar = pt.layers.data("pvar", shape=[4], append_batch_size=True)
+    det = pt.layers.detection_output(loc, conf, priors, pvar,
+                                     confidence_threshold=0.3,
+                                     nms_threshold=0.5, keep_top_k=3)
+    exe = pt.Executor()
+    locv = np.zeros((1, k, 4), np.float32)
+    confv = np.zeros((1, k, 2), np.float32)
+    confv[0, :, 1] = [4.0, 3.9, 3.8]  # all strongly class 1
+    confv[0, :, 0] = -4.0
+    (out,) = exe.run(
+        feed={"loc": locv, "conf": confv, "priors": priors_np, "pvar": var_np},
+        fetch_list=[det],
+    )
+    labels = out[0, :, 0]
+    # priors 0 and 1 overlap heavily → one suppressed; prior 2 kept
+    assert (labels == 1.0).sum() == 2
+
+
+def test_multibox_loss_trains():
+    rng = np.random.RandomState(1)
+    k = 8
+    priors_np, var_np = make_prior_boxes(2, 2, 16, 16, [6.0], [], [2.0],
+                                         [0.1, 0.1, 0.2, 0.2])
+    k = priors_np.shape[0]
+    gt_np = np.array([[[0.05, 0.05, 0.45, 0.45], [0.5, 0.5, 0.95, 0.95]]],
+                     np.float32)
+    gtl_np = np.array([[1, 2]], np.int32)
+
+    loc = pt.layers.data("loc", shape=[k, 4])
+    feat = pt.layers.data("feat", shape=[k * 6])
+    priors = pt.layers.data("priors", shape=[4], append_batch_size=True)
+    pvar = pt.layers.data("pvar", shape=[4], append_batch_size=True)
+    gt = pt.layers.data("gt", shape=[2, 4])
+    gtl = pt.layers.data("gtl", shape=[2], dtype=np.int32)
+    locp = pt.layers.fc(feat, size=k * 4)
+    confp = pt.layers.fc(feat, size=k * 3)
+    loss = pt.layers.mean(pt.layers.multibox_loss(
+        locp, confp, priors, pvar, gt, gtl, overlap_threshold=0.3))
+    pt.optimizer.Adam(learning_rate=0.05).minimize(loss)
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    featv = rng.randn(1, k * 6).astype(np.float32)
+    losses = []
+    for _ in range(40):
+        (l,) = exe.run(
+            feed={"feat": featv, "priors": priors_np, "pvar": var_np,
+                  "gt": gt_np, "gtl": gtl_np, "loc": np.zeros((1, k, 4), np.float32)},
+            fetch_list=[loss],
+        )
+        losses.append(float(l))
+    assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
+    assert np.isfinite(losses).all()

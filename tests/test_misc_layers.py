@@ -1,0 +1,460 @@
+"""Tests for the widened layer set (misc transforms + cost family).
+
+Reference analogues: gserver/tests/test_LayerGrad.cpp cases for each layer
+(maxout, prelu, cos_sim, pad, crop, multiplex, bilinear_interp, row_conv,
+conv_shift, roi_pool, spp, rank/lambda/huber costs, nce, hsigmoid) — here
+checked against NumPy oracles and, where natural, torch (CPU) oracles.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+
+
+def _run(fetch, feed):
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    return exe.run(feed=feed, fetch_list=fetch)
+
+
+def test_gather_scatter_one_hot():
+    x = pt.layers.data("x", shape=[4])
+    idx = pt.layers.data("idx", shape=[], dtype=np.int32, append_batch_size=False)
+    g = pt.layers.gather(x, idx)
+    xv = np.arange(20, dtype=np.float32).reshape(5, 4)
+    iv = np.array([3, 0, 3], np.int32)
+    (out,) = _run([g], {"x": xv, "idx": iv})
+    np.testing.assert_allclose(out, xv[iv])
+
+    pt.reset()
+    x = pt.layers.data("x", shape=[4])
+    idx = pt.layers.data("idx", shape=[], dtype=np.int32, append_batch_size=False)
+    upd = pt.layers.data("upd", shape=[4])
+    s = pt.layers.scatter(x, idx, upd, overwrite=False)
+    uv = np.ones((2, 4), np.float32)
+    iv2 = np.array([1, 1], np.int32)
+    (out,) = _run([s], {"x": xv, "idx": iv2, "upd": uv})
+    exp = xv.copy()
+    exp[1] += 2.0
+    np.testing.assert_allclose(out, exp)
+
+    pt.reset()
+    lbl = pt.layers.data("l", shape=[1], dtype=np.int32)
+    oh = pt.layers.one_hot(lbl, depth=6)
+    (out,) = _run([oh], {"l": np.array([[2], [5]], np.int32)})
+    assert out.shape == (2, 6) and out[0, 2] == 1 and out[1, 5] == 1
+
+
+def test_pad_crop_multiplex():
+    x = pt.layers.data("x", shape=[2, 3], append_batch_size=False)
+    p = pt.layers.pad(x, paddings=[0, 0, 1, 1], pad_value=9.0)
+    c = pt.layers.crop(x, offsets=[0, 1], shape=[2, 2])
+    xv = np.arange(6, dtype=np.float32).reshape(2, 3)
+    pv, cv = _run([p, c], {"x": xv})
+    assert pv.shape == (2, 5) and pv[0, 0] == 9.0
+    np.testing.assert_allclose(cv, xv[:, 1:3])
+
+    pt.reset()
+    a = pt.layers.data("a", shape=[3])
+    b = pt.layers.data("b", shape=[3])
+    ids = pt.layers.data("ids", shape=[1], dtype=np.int32)
+    m = pt.layers.multiplex([a, b], ids)
+    av = np.zeros((2, 3), np.float32)
+    bv = np.ones((2, 3), np.float32)
+    (out,) = _run([m], {"a": av, "b": bv, "ids": np.array([[1], [0]], np.int32)})
+    np.testing.assert_allclose(out, [[1, 1, 1], [0, 0, 0]])
+
+
+def test_maxout_prelu():
+    x = pt.layers.data("x", shape=[4, 2, 2])
+    y = pt.layers.maxout(x, groups=2)
+    rng = np.random.RandomState(0)
+    xv = rng.randn(3, 4, 2, 2).astype(np.float32)
+    (out,) = _run([y], {"x": xv})
+    np.testing.assert_allclose(out, xv.reshape(3, 2, 2, 2, 2).max(axis=2),
+                               rtol=1e-6)
+
+    pt.reset()
+    x = pt.layers.data("x", shape=[5])
+    y = pt.layers.prelu(x, mode="all")
+    xv = np.array([[-2.0, -1.0, 0.0, 1.0, 2.0]], np.float32)
+    (out,) = _run([y], {"x": xv})
+    np.testing.assert_allclose(out, np.where(xv > 0, xv, 0.25 * xv), rtol=1e-6)
+
+
+def test_similarity_family():
+    rng = np.random.RandomState(1)
+    xv = rng.randn(4, 6).astype(np.float32)
+    yv = rng.randn(4, 6).astype(np.float32)
+    x = pt.layers.data("x", shape=[6])
+    y = pt.layers.data("y", shape=[6])
+    cs = pt.layers.cos_sim(x, y)
+    dp = pt.layers.dot_prod(x, y)
+    l2 = pt.layers.l2_distance(x, y)
+    rn = pt.layers.row_l2_norm(x)
+    csv, dpv, l2v, rnv = _run([cs, dp, l2, rn], {"x": xv, "y": yv})
+    exp_cs = (xv * yv).sum(1) / (
+        np.linalg.norm(xv, axis=1) * np.linalg.norm(yv, axis=1)
+    )
+    np.testing.assert_allclose(csv[:, 0], exp_cs, rtol=1e-5)
+    np.testing.assert_allclose(dpv[:, 0], (xv * yv).sum(1), rtol=1e-5)
+    np.testing.assert_allclose(l2v[:, 0], np.linalg.norm(xv - yv, axis=1),
+                               rtol=1e-5)
+    np.testing.assert_allclose(
+        rnv, xv / np.linalg.norm(xv, axis=1, keepdims=True), rtol=1e-5
+    )
+
+
+def test_row_scalar_family():
+    rng = np.random.RandomState(2)
+    xv = np.abs(rng.randn(3, 4)).astype(np.float32) + 0.5
+    yv = rng.randn(3, 4).astype(np.float32)
+    wv = rng.rand(3, 1).astype(np.float32)
+    x = pt.layers.data("x", shape=[4])
+    y = pt.layers.data("y", shape=[4])
+    w = pt.layers.data("w", shape=[1])
+    interp = pt.layers.interpolation(x, y, w)
+    pw = pt.layers.power(x, w)
+    sc = pt.layers.scaling(x, w)
+    si = pt.layers.slope_intercept(x, slope=2.0, intercept=-1.0)
+    s1 = pt.layers.sum_to_one_norm(x)
+    iv, pv, scv, siv, s1v = _run([interp, pw, sc, si, s1],
+                                 {"x": xv, "y": yv, "w": wv})
+    np.testing.assert_allclose(iv, wv * xv + (1 - wv) * yv, rtol=1e-5)
+    np.testing.assert_allclose(pv, np.power(xv, wv), rtol=1e-4)
+    np.testing.assert_allclose(scv, wv * xv, rtol=1e-5)
+    np.testing.assert_allclose(siv, 2 * xv - 1, rtol=1e-5)
+    np.testing.assert_allclose(s1v, xv / xv.sum(1, keepdims=True), rtol=1e-5)
+
+
+def test_geometry_transforms():
+    xv = np.arange(24, dtype=np.float32).reshape(1, 2, 3, 4)
+    x = pt.layers.data("x", shape=[2, 3, 4])
+    rot = pt.layers.rotate(x)
+    sw = pt.layers.switch_order(x)
+    rv, sv = _run([rot, sw], {"x": xv})
+    np.testing.assert_allclose(rv, np.rot90(xv, k=1, axes=(2, 3)))
+    np.testing.assert_allclose(sv, xv.transpose(0, 2, 3, 1))
+
+
+def test_bilinear_interp_matches_torch():
+    torch = pytest.importorskip("torch")
+    rng = np.random.RandomState(3)
+    xv = rng.randn(2, 3, 5, 7).astype(np.float32)
+    x = pt.layers.data("x", shape=[3, 5, 7])
+    y = pt.layers.bilinear_interp(x, out_h=10, out_w=14)
+    (out,) = _run([y], {"x": xv})
+    ref = torch.nn.functional.interpolate(
+        torch.tensor(xv), size=(10, 14), mode="bilinear", align_corners=True
+    ).numpy()
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_im2sequence_row_conv_conv_shift():
+    rng = np.random.RandomState(4)
+    xv = rng.randn(2, 3, 4, 4).astype(np.float32)
+    x = pt.layers.data("x", shape=[3, 4, 4])
+    seq = pt.layers.im2sequence(x, block_y=2, block_x=2, stride_y=2, stride_x=2)
+    (out,) = _run([seq], {"x": xv})
+    assert out.shape == (2, 4, 12)
+    # first patch of first image = channels-major 2x2 block
+    blk = xv[0, :, 0:2, 0:2].reshape(-1)
+    np.testing.assert_allclose(out[0, 0], blk, rtol=1e-6)
+
+    pt.reset()
+    tv = rng.randn(2, 5, 3).astype(np.float32)
+    t = pt.layers.data("t", shape=[5, 3], append_batch_size=True)
+    rc = pt.layers.row_conv(t, future_context_size=2)
+    (out,) = _run([rc], {"t": tv})
+    assert out.shape == tv.shape
+
+    pt.reset()
+    xv2 = rng.randn(3, 8).astype(np.float32)
+    yv2 = rng.randn(3, 3).astype(np.float32)
+    a = pt.layers.data("a", shape=[8])
+    b = pt.layers.data("b", shape=[3])
+    csh = pt.layers.conv_shift(a, b)
+    (out,) = _run([csh], {"a": xv2, "b": yv2})
+    exp = np.zeros_like(xv2)
+    for n in range(3):
+        for d in range(8):
+            for j in range(3):
+                exp[n, d] += yv2[n, j] * xv2[n, (d + j - 1) % 8]
+    np.testing.assert_allclose(out, exp, rtol=1e-5)
+
+
+def test_factored_layers():
+    rng = np.random.RandomState(5)
+    xv = rng.randn(4, 6).astype(np.float32)
+    yv = rng.randn(4, 3).astype(np.float32)
+    x = pt.layers.data("x", shape=[6])
+    y = pt.layers.data("y", shape=[3])
+    op = pt.layers.out_prod(x, y)
+    fm = pt.layers.factorization_machine(x, factor_size=4)
+    bt = pt.layers.bilinear_tensor_product(x, y, size=2)
+    sf = pt.layers.selective_fc(x, size=5)
+    opv, fmv, btv, sfv = _run([op, fm, bt, sf], {"x": xv, "y": yv})
+    np.testing.assert_allclose(
+        opv, (xv[:, :, None] * yv[:, None, :]).reshape(4, -1), rtol=1e-5
+    )
+    assert fmv.shape == (4, 1) and btv.shape == (4, 2) and sfv.shape == (4, 5)
+
+
+def test_3d_conv_pool_matches_torch():
+    torch = pytest.importorskip("torch")
+    rng = np.random.RandomState(6)
+    xv = rng.randn(2, 3, 5, 6, 7).astype(np.float32)
+    x = pt.layers.data("x", shape=[3, 5, 6, 7])
+    y = pt.layers.conv3d(x, num_filters=4, filter_size=3, padding=1,
+                         bias_attr=False)
+    p = pt.layers.pool3d(x, pool_size=2, pool_type="max")
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    w = pt.global_scope().get(
+        [v for v in pt.default_main_program().global_block().vars
+         if ".w" in v][0]
+    )
+    yv, pv = exe.run(feed={"x": xv}, fetch_list=[y, p])
+    ref = torch.nn.functional.conv3d(
+        torch.tensor(xv), torch.tensor(np.asarray(w)), padding=1
+    ).numpy()
+    np.testing.assert_allclose(yv, ref, rtol=1e-3, atol=1e-4)
+    refp = torch.nn.functional.max_pool3d(torch.tensor(xv), 2).numpy()
+    np.testing.assert_allclose(pv, refp, rtol=1e-6)
+
+
+def test_roi_pool_and_spp():
+    xv = np.arange(64, dtype=np.float32).reshape(1, 1, 8, 8)
+    x = pt.layers.data("x", shape=[1, 8, 8])
+    rois = pt.layers.data("rois", shape=[5], append_batch_size=True)
+    rp = pt.layers.roi_pool(x, rois, pooled_height=2, pooled_width=2)
+    sp = pt.layers.spp(x, pyramid_height=2)
+    rv = np.array([[0, 0, 0, 3, 3]], np.float32)  # 4x4 box at origin
+    rpv, spv = _run([rp, sp], {"x": xv, "rois": rv})
+    # 4x4 box max-pooled 2x2: quadrant maxima
+    box = xv[0, 0, 0:4, 0:4]
+    exp = np.array([[box[:2, :2].max(), box[:2, 2:].max()],
+                    [box[2:, :2].max(), box[2:, 2:].max()]])
+    np.testing.assert_allclose(rpv[0, 0], exp)
+    assert spv.shape == (1, 1 * (1 + 4))
+    assert spv[0, 0] == 63.0  # global max
+
+
+def test_cost_family_oracles():
+    torch = pytest.importorskip("torch")
+    rng = np.random.RandomState(7)
+    xv = rng.randn(6, 4).astype(np.float32)
+    lv = (rng.rand(6, 4) > 0.5).astype(np.float32)
+    x = pt.layers.data("x", shape=[4])
+    l = pt.layers.data("l", shape=[4])
+    bce = pt.layers.sigmoid_cross_entropy_with_logits(x, l)
+    (out,) = _run([bce], {"x": xv, "l": lv})
+    ref = torch.nn.functional.binary_cross_entropy_with_logits(
+        torch.tensor(xv), torch.tensor(lv), reduction="none"
+    ).numpy()
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+
+    pt.reset()
+    pv = 1 / (1 + np.exp(-xv))
+    x2 = pt.layers.data("x", shape=[4])
+    l2 = pt.layers.data("l", shape=[4])
+    b2 = pt.layers.binary_cross_entropy(x2, l2)
+    (out2,) = _run([b2], {"x": pv, "l": lv})
+    ref2 = torch.nn.functional.binary_cross_entropy(
+        torch.tensor(pv), torch.tensor(lv), reduction="none"
+    ).numpy()
+    np.testing.assert_allclose(out2, ref2, rtol=1e-4, atol=1e-5)
+
+    pt.reset()
+    sv = rng.randn(5, 3).astype(np.float32)
+    tv = rng.randn(5, 3).astype(np.float32)
+    a = pt.layers.data("a", shape=[3])
+    b = pt.layers.data("b", shape=[3])
+    sl1 = pt.layers.smooth_l1(a, b)
+    (out3,) = _run([sl1], {"a": sv, "b": tv})
+    ref3 = torch.nn.functional.smooth_l1_loss(
+        torch.tensor(sv), torch.tensor(tv), reduction="none"
+    ).numpy().sum(1, keepdims=True)
+    np.testing.assert_allclose(out3, ref3, rtol=1e-4, atol=1e-5)
+
+
+def test_rank_and_margin_costs():
+    rng = np.random.RandomState(8)
+    lv = rng.randn(5, 1).astype(np.float32)
+    rv = rng.randn(5, 1).astype(np.float32)
+    yv = (rng.rand(5, 1) > 0.5).astype(np.float32)
+    left = pt.layers.data("left", shape=[1])
+    right = pt.layers.data("right", shape=[1])
+    label = pt.layers.data("label", shape=[1])
+    rc = pt.layers.rank_cost(left, right, label)
+    ml = pt.layers.margin_rank_loss(left, right, label, margin=0.1)
+    rcv, mlv = _run([rc, ml], {"left": lv, "right": rv, "label": yv})
+    o = (lv - rv)[:, 0]
+    exp = np.log1p(np.exp(-np.abs(o))) + np.maximum(o, 0) - yv[:, 0] * o
+    np.testing.assert_allclose(rcv[:, 0], exp, rtol=1e-5, atol=1e-6)
+    expm = np.maximum(0, -yv[:, 0] * o + 0.1)
+    np.testing.assert_allclose(mlv[:, 0], expm, rtol=1e-5, atol=1e-6)
+
+
+def test_huber_classification_and_selfnorm():
+    xv = np.array([[-2.0], [-0.5], [0.5], [2.0]], np.float32)
+    yv = np.array([[0], [0], [1], [1]], np.float32)
+    x = pt.layers.data("x", shape=[1])
+    y = pt.layers.data("y", shape=[1])
+    hc = pt.layers.huber_classification_cost(x, y)
+    (out,) = _run([hc], {"x": xv, "y": yv})
+    # y=-1,x=-2 → a=2 → 0 ; y=-1,x=-.5 → a=.5 → (1-.5)^2 ; etc.
+    np.testing.assert_allclose(out[:, 0], [0.0, 0.25, 0.25, 0.0], rtol=1e-5)
+
+    pt.reset()
+    probs = np.abs(np.random.RandomState(9).randn(4, 5)).astype(np.float32) + 0.1
+    lab = np.array([[0], [1], [2], [3]], np.int32)
+    p = pt.layers.data("p", shape=[5])
+    l = pt.layers.data("l", shape=[1], dtype=np.int32)
+    cs = pt.layers.cross_entropy_with_selfnorm(p, l, softmax_selfnorm_alpha=0.5)
+    (out2,) = _run([cs], {"p": probs, "l": lab})
+    z = probs.sum(1)
+    exp = -np.log(probs[np.arange(4), lab[:, 0]] / z) + 0.5 * np.log(z) ** 2
+    np.testing.assert_allclose(out2[:, 0], exp, rtol=1e-4)
+
+
+def test_lambda_cost_ranks_correctly():
+    # perfectly-ordered list should have cost ≈ -1 (NDCG=1); inverted worse
+    good = np.array([[3.0, 2.0, 1.0, 0.5]], np.float32)
+    lab = np.array([[3.0, 2.0, 1.0, 0.0]], np.float32)
+    s = pt.layers.data("s", shape=[4])
+    l = pt.layers.data("l", shape=[4])
+    lc = pt.layers.lambda_cost(s, l, NDCG_num=4)
+    (out_good,) = _run([lc], {"s": good, "l": lab})
+    pt.reset()
+    s = pt.layers.data("s", shape=[4])
+    l = pt.layers.data("l", shape=[4])
+    lc = pt.layers.lambda_cost(s, l, NDCG_num=4)
+    (out_bad,) = _run([lc], {"s": -good, "l": lab})
+    assert out_good[0, 0] < out_bad[0, 0]  # lower cost = better ranking
+    assert out_good[0, 0] < -0.8
+
+
+def test_nce_and_hsigmoid_train():
+    """Both sampled-softmax surrogates must be trainable: loss decreases."""
+    rng = np.random.RandomState(10)
+    n, d, c = 32, 8, 17
+    xv = rng.randn(n, d).astype(np.float32)
+    lv = rng.randint(0, c, (n, 1)).astype(np.int32)
+
+    for kind in ("nce", "hsigmoid"):
+        pt.reset()
+        x = pt.layers.data("x", shape=[d])
+        l = pt.layers.data("l", shape=[1], dtype=np.int32)
+        h = pt.layers.fc(x, size=16, act="tanh")
+        if kind == "nce":
+            cost = pt.layers.nce(h, l, num_classes=c, num_neg_samples=5)
+        else:
+            cost = pt.layers.hsigmoid(h, l, num_classes=c)
+        loss = pt.layers.mean(cost)
+        pt.optimizer.SGD(learning_rate=0.2).minimize(loss)
+        exe = pt.Executor()
+        exe.run(pt.default_startup_program())
+        losses = []
+        for _ in range(30):
+            (lval,) = exe.run(feed={"x": xv, "l": lv}, fetch_list=[loss])
+            losses.append(float(lval))
+        assert losses[-1] < losses[0] * 0.9, (kind, losses[0], losses[-1])
+
+
+def test_hsigmoid_path_tables():
+    from paddle_tpu.ops.cost_ops import _hsigmoid_tables
+
+    nodes, bits, valid = _hsigmoid_tables(8)
+    # class 0: code 8 = 0b1000, depth 3, ancestors 1,2,4 → rows 0,1,3
+    np.testing.assert_array_equal(nodes[0][:3], [0, 1, 3])
+    np.testing.assert_array_equal(bits[0][:3], [0, 0, 0])
+    # class 7: code 15 = 0b1111 → ancestors 1,3,7 → rows 0,2,6, bits 1,1,1
+    np.testing.assert_array_equal(nodes[7][:3], [0, 2, 6])
+    np.testing.assert_array_equal(bits[7][:3], [1, 1, 1])
+    assert valid[0].sum() == 3
+
+
+def test_sampling_id_distribution():
+    probs = np.array([[0.0, 1.0, 0.0], [1.0, 0.0, 0.0]], np.float32)
+    x = pt.layers.data("x", shape=[3])
+    s = pt.layers.sampling_id(x)
+    (out,) = _run([s], {"x": probs})
+    np.testing.assert_array_equal(out, [1, 0])
+
+
+def test_row_conv_lod_respects_boundaries():
+    from paddle_tpu.core.lod import LoDArray
+
+    x = pt.layers.data("x", shape=[-1, 2], lod_level=1, append_batch_size=False)
+    rc = pt.layers.row_conv(x, future_context_size=1)
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    seqs = [[[1.0, 1.0], [2.0, 2.0]], [[10.0, 10.0]]]
+    lod = LoDArray.from_sequences([np.asarray(s, np.float32) for s in seqs],
+                                  bucket=8)
+    wname = [v for v in pt.default_main_program().global_block().vars
+             if ".w" in v][0]
+    pt.global_scope().set(wname, np.array([[1.0, 1.0], [1.0, 1.0]], np.float32))
+    (out,) = exe.run(feed={"x": lod}, fetch_list=[rc], return_numpy=False)
+    d = np.asarray(out.data)
+    # token 0: x0 + x1 = [3,3]; token 1 (last of seq 0): must NOT see seq 1
+    np.testing.assert_allclose(d[0], [3.0, 3.0])
+    np.testing.assert_allclose(d[1], [2.0, 2.0])
+    np.testing.assert_allclose(d[2], [10.0, 10.0])
+
+
+def test_roi_pool_empty_bins_are_zero():
+    xv = np.arange(64, dtype=np.float32).reshape(1, 1, 8, 8) + 1.0
+    x = pt.layers.data("x", shape=[1, 8, 8])
+    rois = pt.layers.data("rois", shape=[5], append_batch_size=True)
+    rp = pt.layers.roi_pool(x, rois, pooled_height=2, pooled_width=2)
+    rv = np.array([[0, 2, 2, 2, 2]], np.float32)  # 1x1 box < 2x2 grid
+    (out,) = _run([rp], {"x": xv, "rois": rv})
+    assert np.isfinite(out).all()
+    # floor/ceil windows: every bin of a 1x1 ROI covers the single pixel
+    # (reference hstart=floor(b*1/2)=0, hend=ceil((b+1)*1/2)=1 for both bins)
+    np.testing.assert_allclose(out[0, 0], np.full((2, 2), xv[0, 0, 2, 2]))
+
+
+def test_spp_small_input_no_padding_artifacts():
+    # h=w=2 with pyramid_height=3 (finest grid 4x4 > input): every bin must
+    # still read a real pixel — no -inf, and avg bins must not be diluted
+    xv = np.ones((1, 2, 2, 2), np.float32) * 5.0
+    x = pt.layers.data("x", shape=[2, 2, 2])
+    sm = pt.layers.spp(x, pyramid_height=3, pool_type="max")
+    sa = pt.layers.spp(x, pyramid_height=3, pool_type="avg")
+    mv, av = _run([sm, sa], {"x": xv})
+    assert mv.shape == (1, 2 * (1 + 4 + 16)) and av.shape == mv.shape
+    np.testing.assert_allclose(mv, 5.0)
+    np.testing.assert_allclose(av, 5.0)
+
+
+def test_cos_sim_lod_feeds_sequence_pool():
+    from paddle_tpu.core.lod import LoDArray
+
+    x = pt.layers.data("x", shape=[-1, 3], lod_level=1, append_batch_size=False)
+    y = pt.layers.data("y", shape=[-1, 3], lod_level=1, append_batch_size=False)
+    cs = pt.layers.cos_sim(x, y)
+    pooled = pt.layers.sequence_pool(cs, "sum")
+    exe = pt.Executor()
+    seqs = [[[1.0, 0.0, 0.0], [0.0, 1.0, 0.0]], [[0.0, 0.0, 2.0]]]
+    lx = LoDArray.from_sequences([np.asarray(s, np.float32) for s in seqs],
+                                 bucket=8)
+    (out,) = exe.run(feed={"x": lx, "y": lx}, fetch_list=[pooled])
+    np.testing.assert_allclose(out[:2, 0], [2.0, 1.0], rtol=1e-5)
+
+
+def test_roi_pool_overlapping_bins():
+    # ROI height/width 5 with 2x2 grid: reference floor/ceil windows overlap
+    # at the middle row/col — row 2 belongs to BOTH bins
+    xv = np.zeros((1, 1, 8, 8), np.float32)
+    xv[0, 0, 2, 2] = 99.0  # center pixel of a 5x5 box at origin
+    x = pt.layers.data("x", shape=[1, 8, 8])
+    rois = pt.layers.data("rois", shape=[5], append_batch_size=True)
+    rp = pt.layers.roi_pool(x, rois, pooled_height=2, pooled_width=2)
+    rv = np.array([[0, 0, 0, 4, 4]], np.float32)
+    (out,) = _run([rp], {"x": xv, "rois": rv})
+    # pixel (2,2) must appear in every bin's max (reference semantics)
+    np.testing.assert_allclose(out[0, 0], [[99, 99], [99, 99]])

@@ -1,0 +1,58 @@
+"""Composite network builder tests (reference:
+trainer_config_helpers/networks.py, fluid nets.py + their config tests in
+trainer_config_helpers/tests/configs/).
+"""
+
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu import networks
+from paddle_tpu.core.lod import LoDArray
+
+
+def test_simple_img_conv_pool_shapes():
+    img = pt.layers.data("img", shape=[1, 28, 28])
+    out = networks.simple_img_conv_pool(img, num_filters=8, filter_size=5,
+                                        pool_size=2)
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    (v,) = exe.run(feed={"img": np.zeros((2, 1, 28, 28), np.float32)},
+                   fetch_list=[out])
+    assert v.shape == (2, 8, 12, 12)
+
+
+def test_img_conv_group_vgg_block():
+    img = pt.layers.data("img", shape=[3, 8, 8])
+    out = networks.img_conv_group(img, conv_num_filter=[4, 4],
+                                  conv_with_batchnorm=True)
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    (v,) = exe.run(feed={"img": np.random.randn(2, 3, 8, 8).astype(np.float32)},
+                   fetch_list=[out])
+    assert v.shape == (2, 4, 4, 4)
+
+
+def test_bidirectional_lstm_and_seq_conv_pool():
+    x = pt.layers.data("x", shape=[-1, 1], dtype=np.int32, lod_level=1,
+                       append_batch_size=False)
+    emb = pt.layers.embedding(x, size=[20, 6])
+    bi = networks.bidirectional_lstm(emb, size=5)
+    pooled = pt.layers.sequence_pool(bi, "max")
+    scp = networks.sequence_conv_pool(emb, num_filters=7, filter_size=3)
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    seqs = [np.array([[1], [2], [3]], np.int32), np.array([[4]], np.int32)]
+    lod = LoDArray.from_sequences(seqs, bucket=16)
+    pv, sv = exe.run(feed={"x": lod}, fetch_list=[pooled, scp])
+    assert pv.shape[1] == 10  # 2 * hidden
+    assert sv.shape[1] == 7
+
+
+def test_glu():
+    x = pt.layers.data("x", shape=[8])
+    g = networks.glu(x, dim=-1)
+    exe = pt.Executor()
+    xv = np.random.RandomState(0).randn(3, 8).astype(np.float32)
+    (out,) = exe.run(feed={"x": xv}, fetch_list=[g])
+    a, b = xv[:, :4], xv[:, 4:]
+    np.testing.assert_allclose(out, a / (1 + np.exp(-b)), rtol=1e-5)

@@ -1,0 +1,195 @@
+"""Tests for the widened sequence set: slice/reshape/reverse/kmax/
+sub_nested/featmap/eos/sequence_conv.
+
+Reference analogues: gserver/tests/test_SeqSliceLayerGrad.cpp,
+test_KmaxSeqScore.cpp, test_CrossEntropyOverBeamGrad.cpp fixtures and the
+fluid tests test_sequence_slice_op.py / test_sequence_conv.py.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.core.lod import LoDArray
+
+
+def _lod(seqs, dtype=np.float32, **kw):
+    return LoDArray.from_sequences([np.asarray(s, dtype) for s in seqs], **kw)
+
+
+def _ragged(out):
+    """LoDArray result → list of per-sequence numpy arrays."""
+    data = np.asarray(out.data)
+    lens = np.asarray(out.lengths)
+    n = int(out.num_seqs)
+    offs = np.concatenate([[0], np.cumsum(lens)])
+    return [data[offs[i] : offs[i + 1]] for i in range(n)]
+
+
+def test_sequence_slice():
+    x = pt.layers.data("x", shape=[-1, 2], lod_level=1, append_batch_size=False)
+    off = pt.layers.data("off", shape=[2], dtype=np.int32, append_batch_size=False)
+    ln = pt.layers.data("ln", shape=[2], dtype=np.int32, append_batch_size=False)
+    y = pt.layers.sequence_slice(x, off, ln)
+    exe = pt.Executor()
+    seqs = [[[1, 1], [2, 2], [3, 3], [4, 4]], [[10, 10], [20, 20]]]
+    (out,) = exe.run(
+        feed={"x": _lod(seqs, bucket=8),
+              "off": np.array([1, 0], np.int32),
+              "ln": np.array([2, 1], np.int32)},
+        fetch_list=[y], return_numpy=False)
+    r = _ragged(out)
+    np.testing.assert_allclose(r[0], [[2, 2], [3, 3]])
+    np.testing.assert_allclose(r[1], [[10, 10]])
+
+
+def test_sequence_reshape():
+    x = pt.layers.data("x", shape=[-1, 4], lod_level=1, append_batch_size=False)
+    y = pt.layers.sequence_reshape(x, new_dim=2)
+    exe = pt.Executor()
+    seqs = [[[1, 2, 3, 4]], [[5, 6, 7, 8], [9, 10, 11, 12]]]
+    (out,) = exe.run(feed={"x": _lod(seqs, bucket=4)}, fetch_list=[y],
+                     return_numpy=False)
+    r = _ragged(out)
+    np.testing.assert_allclose(r[0], [[1, 2], [3, 4]])
+    np.testing.assert_allclose(r[1], [[5, 6], [7, 8], [9, 10], [11, 12]])
+
+
+def test_sequence_reverse():
+    x = pt.layers.data("x", shape=[-1, 1], lod_level=1, append_batch_size=False)
+    y = pt.layers.sequence_reverse(x)
+    exe = pt.Executor()
+    seqs = [[[1], [2], [3]], [[4], [5]]]
+    (out,) = exe.run(feed={"x": _lod(seqs, bucket=8)}, fetch_list=[y],
+                     return_numpy=False)
+    r = _ragged(out)
+    np.testing.assert_allclose(r[0], [[3], [2], [1]])
+    np.testing.assert_allclose(r[1], [[5], [4]])
+
+
+def test_kmax_seq_score():
+    x = pt.layers.data("x", shape=[-1, 1], lod_level=1, append_batch_size=False)
+    y = pt.layers.kmax_seq_score(x, beam_size=2)
+    exe = pt.Executor()
+    seqs = [[[0.1], [0.9], [0.5]], [[0.7]]]
+    (out,) = exe.run(feed={"x": _lod(seqs, bucket=8)}, fetch_list=[y])
+    np.testing.assert_array_equal(out[0], [1, 2])  # indices within seq 0
+    assert out[1][0] == 0 and out[1][1] == -1  # second slot padded
+
+
+def test_sub_nested_seq():
+    x = pt.layers.data("x", shape=[-1, 1], lod_level=2, append_batch_size=False)
+    sel = pt.layers.data("sel", shape=[3], dtype=np.int32,
+                         append_batch_size=False)
+    y = pt.layers.sub_nested_seq(x, sel)
+    exe = pt.Executor()
+    # nested: seq0 = [[1,2],[3]], seq1 = [[4,5,6]] → global subs 0,1,2
+    nested = [[[[1], [2]], [[3]]], [[[4], [5], [6]]]]
+    lod = LoDArray.from_nested_sequences(
+        [[np.asarray(ss, np.float32) for ss in s] for s in nested], bucket=8)
+    (out,) = exe.run(
+        feed={"x": lod, "sel": np.array([2, 0, -1], np.int32)},
+        fetch_list=[y], return_numpy=False)
+    r = _ragged(out)
+    assert len(r) == 2
+    np.testing.assert_allclose(r[0], [[4], [5], [6]])  # global sub 2
+    np.testing.assert_allclose(r[1], [[1], [2]])  # global sub 0
+
+
+def test_featmap_expand_and_eos():
+    x = pt.layers.data("x", shape=[-1, 2], lod_level=1, append_batch_size=False)
+    y = pt.layers.featmap_expand(x, num_filters=3)
+    exe = pt.Executor()
+    seqs = [[[1.0, 2.0]]]
+    (out,) = exe.run(feed={"x": _lod(seqs, bucket=4)}, fetch_list=[y],
+                     return_numpy=False)
+    np.testing.assert_allclose(np.asarray(out.data)[0],
+                               [1, 2, 1, 2, 1, 2])
+
+    pt.reset()
+    ids = pt.layers.data("ids", shape=[-1, 1], dtype=np.int32, lod_level=1,
+                         append_batch_size=False)
+    e = pt.layers.eos_id(ids, eos_id=2)
+    exe = pt.Executor()
+    lod = _lod([[[1], [2], [3]]], np.int32, bucket=4)
+    (out,) = exe.run(feed={"ids": lod}, fetch_list=[e], return_numpy=False)
+    np.testing.assert_allclose(np.asarray(out.data)[:3, 0], [0, 1, 0])
+
+
+def test_sequence_conv_boundary_masking():
+    x = pt.layers.data("x", shape=[-1, 2], lod_level=1, append_batch_size=False)
+    y = pt.layers.sequence_conv(x, num_filters=2, filter_size=3,
+                                bias_attr=False)
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    # identity-ish filter: output = sum of context window
+    wname = [v for v in pt.default_main_program().global_block().vars
+             if ".w" in v][0]
+    w = np.concatenate([np.eye(2), np.eye(2), np.eye(2)], axis=0).astype(
+        np.float32)
+    pt.global_scope().set(wname, w)
+    seqs = [[[1, 1], [2, 2], [4, 4]], [[10, 10]]]
+    (out,) = exe.run(feed={"x": _lod(seqs, bucket=8)}, fetch_list=[y],
+                     return_numpy=False)
+    r = _ragged(out)
+    # token 0 of seq 0: window (pad, x0, x1) = 1+2 = 3; token 1: 1+2+4=7
+    np.testing.assert_allclose(r[0], [[3, 3], [7, 7], [6, 6]])
+    # seq 1 single token must not see seq 0
+    np.testing.assert_allclose(r[1], [[10, 10]])
+
+
+def test_sequence_conv_trains_text_classifier():
+    """sequence_conv + max-pool text classifier converges (the Gen-1
+    text-conv recipe from the sentiment demo)."""
+    rng = np.random.RandomState(0)
+    vocab, emb_d = 30, 8
+    x = pt.layers.data("x", shape=[-1, 1], dtype=np.int32, lod_level=1,
+                       append_batch_size=False)
+    lab = pt.layers.data("lab", shape=[1], dtype=np.int32)
+    emb = pt.layers.embedding(x, size=[vocab, emb_d])
+    conv = pt.layers.sequence_conv(emb, num_filters=16, filter_size=3,
+                                   act="relu")
+    pooled = pt.layers.sequence_pool(conv, "max")
+    logits = pt.layers.fc(pooled, size=2)
+    loss = pt.layers.mean(pt.layers.softmax_with_cross_entropy(logits, lab))
+    pt.optimizer.Adam(learning_rate=0.05).minimize(loss)
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+
+    def batch():
+        seqs, labs = [], []
+        for _ in range(16):
+            y = rng.randint(0, 2)
+            n = rng.randint(3, 7)
+            toks = rng.randint(10 * y, 10 * y + 10, (n, 1))
+            seqs.append(toks.astype(np.int32))
+            labs.append([y])
+        return (LoDArray.from_sequences(seqs, bucket=128),
+                np.asarray(labs, np.int32))
+
+    losses = []
+    for _ in range(30):
+        xv, lv = batch()
+        (l,) = exe.run(feed={"x": xv, "lab": lv}, fetch_list=[loss])
+        losses.append(float(l))
+    assert losses[-1] < 0.25, losses[-5:]
+
+
+def test_sequence_slice_bucketed_max_seqs():
+    x = pt.layers.data("x", shape=[-1, 1], lod_level=1, append_batch_size=False)
+    off = pt.layers.data("off", shape=[2], dtype=np.int32,
+                         append_batch_size=False)
+    ln = pt.layers.data("ln", shape=[2], dtype=np.int32,
+                        append_batch_size=False)
+    y = pt.layers.sequence_slice(x, off, ln)
+    exe = pt.Executor()
+    seqs = [np.asarray([[1.0], [2.0], [3.0]], np.float32),
+            np.asarray([[4.0], [5.0]], np.float32)]
+    lod = LoDArray.from_sequences(seqs, bucket=8, max_seqs=4)  # bucketed
+    (out,) = exe.run(
+        feed={"x": lod, "off": np.array([1, 0], np.int32),
+              "ln": np.array([1, 2], np.int32)},
+        fetch_list=[y], return_numpy=False)
+    r = _ragged(out)
+    np.testing.assert_allclose(r[0], [[2.0]])
+    np.testing.assert_allclose(r[1], [[4.0], [5.0]])
