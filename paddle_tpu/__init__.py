@@ -19,6 +19,8 @@ Quick start (fit_a_line, reference book/01)::
     out, = exe.run(feed={"x": xs, "y": ys}, fetch_list=[loss])
 """
 
+from . import amp  # noqa: F401
+from .amp import amp_guard  # noqa: F401
 from . import core  # noqa: F401
 from . import ops  # noqa: F401  (registers all kernels)
 from . import evaluator  # noqa: F401

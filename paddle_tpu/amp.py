@@ -1,0 +1,58 @@
+"""Automatic mixed precision (bf16 compute, f32 master weights).
+
+TPU analogue of the reference's half-precision support
+(paddle/math/float16.h:70 and the fp16 GEMM paths in paddle/cuda): on the
+MXU the fast matmul/conv datatype is bfloat16, which — unlike fp16 — keeps
+fp32's exponent range, so no loss scaling is needed.
+
+Design: parameters, optimizer state, and reductions stay float32; only the
+*inputs* to MXU ops (mul/matmul/conv*) are cast to the amp dtype, with
+float32 accumulation (`preferred_element_type`). Enabled per-Program via
+`Program.set_amp("bfloat16")` after building it, or the `pt.amp_guard()`
+context around the *run* calls; the executor reads the setting at run time
+and threads it into the traced env under `@AMP@`, where kernels pick it up
+via `cast_inputs`.
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+import jax.numpy as jnp
+
+AMP_KEY = "@AMP@"
+
+
+def cast_inputs(ctx, *arrays):
+    """Cast float32 arrays to the program's amp dtype (no-op otherwise)."""
+    dtype = ctx.env.get(AMP_KEY)
+    out = []
+    for a in arrays:
+        if (
+            dtype is not None
+            and hasattr(a, "dtype")
+            and a.dtype == jnp.float32
+        ):
+            a = a.astype(dtype)
+        out.append(a)
+    return out[0] if len(out) == 1 else tuple(out)
+
+
+@contextlib.contextmanager
+def amp_guard(dtype: str = "bfloat16", main_program=None):
+    """Enable amp on the current (or given) main program for the block.
+
+    The flag is read at *run* time (the executor threads it into the traced
+    env per compile), so wrap the `exe.run(...)` calls — or simply call
+    `program.set_amp(...)` once after building. Wrapping only the layer-
+    construction code would be a no-op: the guard restores the previous
+    setting on exit, before any run happens."""
+    from .core.program import default_main_program
+
+    prog = main_program or default_main_program()
+    prev = prog.amp_dtype
+    prog.set_amp(dtype)
+    try:
+        yield
+    finally:
+        prog.set_amp(prev)

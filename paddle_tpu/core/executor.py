@@ -131,8 +131,13 @@ class Executor:
     feed, fetch_list)`. Compilation is cached per (program version, feed
     shapes, fetch list)."""
 
-    def __init__(self, place: Optional[Place] = None):
+    def __init__(self, place: Optional[Place] = None, donate_state: bool = False):
         self.place = place or default_place()
+        # donate_state=True lets XLA reuse the parameter/optimizer-state
+        # buffers in-place across steps (halves peak HBM for the update).
+        # Off by default: donation invalidates any outstanding references to
+        # the old arrays outside the Scope.
+        self.donate_state = donate_state
         self._cache: Dict[Any, Any] = {}
 
     # -- subclass hooks (ParallelExecutor overrides these) -------------
@@ -175,6 +180,7 @@ class Executor:
         key = self._cache_key_prefix() + (
             id(program),
             program.version,
+            program.amp_dtype,
             _feed_signature(feed),
             tuple(fetch_names),
             tuple(persist_names),
@@ -215,6 +221,7 @@ class Executor:
             env.update(feed)
             env["@RNG@"] = jax.random.PRNGKey(seed)
             env["@RNG_COUNTER@"] = 0
+            env["@AMP@"] = program.amp_dtype
             runner.run_block(0, env)
             fetches = [env[n] for n in fetch_names]
             new_state = {
@@ -224,4 +231,5 @@ class Executor:
             }
             return fetches, new_state
 
-        return jax.jit(raw)
+        donate = (0,) if self.donate_state else ()
+        return jax.jit(raw, donate_argnums=donate)

@@ -171,6 +171,16 @@ class Program:
         self._current_block_idx = 0
         self._version = 0
         self.random_seed: int = 0
+        # mixed-precision compute dtype (None = full f32); see paddle_tpu/amp.py
+        self.amp_dtype: Optional[str] = None
+
+    def set_amp(self, dtype: Optional[str] = "bfloat16") -> None:
+        """Enable/disable bf16 mixed-precision compute for MXU ops.
+
+        The executor keys its compile cache on the amp setting, so toggling
+        (e.g. amp_guard around run calls in a loop) reuses both compiled
+        variants rather than recompiling."""
+        self.amp_dtype = dtype
 
     # -- structure ----------------------------------------------------------
     def global_block(self) -> Block:

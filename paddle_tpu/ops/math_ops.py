@@ -17,6 +17,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .. import amp
 from ..core.lod import LoDArray
 from ..core.registry import register_op
 
@@ -43,6 +44,7 @@ def mul_kernel(ctx):
     xs, ys = x.shape, y.shape
     x2 = x.reshape((int(np.prod(xs[:xd])), -1)) if x.ndim > 2 or xd != 1 else x
     y2 = y.reshape((int(np.prod(ys[:yd])), -1)) if y.ndim > 2 or yd != 1 else y
+    x2, y2 = amp.cast_inputs(ctx, x2, y2)
     out = jnp.dot(x2, y2, preferred_element_type=jnp.float32)
     # restore leading dims: out shape is xs[:xd] + ys[yd:] (mul_op.cc InferShape)
     out_shape = tuple(xs[:xd]) + tuple(ys[yd:])
@@ -61,7 +63,9 @@ def matmul_kernel(ctx):
         x = jnp.swapaxes(x, -1, -2)
     if ctx.attr("transpose_Y", False):
         y = jnp.swapaxes(y, -1, -2)
-    out = jnp.matmul(x, y, preferred_element_type=jnp.float32).astype(x.dtype)
+    dtype = x.dtype
+    x, y = amp.cast_inputs(ctx, x, y)
+    out = jnp.matmul(x, y, preferred_element_type=jnp.float32).astype(dtype)
     ctx.set_output("Out", out)
 
 
@@ -284,7 +288,8 @@ def assign_kernel(ctx):
 @register_op("increment")
 def increment_kernel(ctx):
     x = _data(ctx.input("X"))
-    ctx.set_output("Out", x + ctx.attr("step", 1.0))
+    # cast the step to x's dtype: int counters must stay ints
+    ctx.set_output("Out", x + jnp.asarray(ctx.attr("step", 1.0), dtype=x.dtype))
 
 
 @register_op("argmax")

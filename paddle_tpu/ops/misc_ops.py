@@ -47,6 +47,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .. import amp
 from ..core.lod import LoDArray
 from ..core.registry import register_op
 
@@ -413,15 +414,18 @@ def conv3d_kernel(ctx):
     stride = tuple(ctx.attr("strides", (1, 1, 1)))
     pad = tuple(ctx.attr("paddings", (0, 0, 0)))
     groups = ctx.attr("groups", 1)
+    dtype = x.dtype
+    xc, wc = amp.cast_inputs(ctx, x, w)
+    acc = jnp.float32 if xc.dtype == jnp.float32 else None
     out = jax.lax.conv_general_dilated(
-        x,
-        w,
+        xc,
+        wc,
         window_strides=stride,
         padding=[(p, p) for p in pad],
         feature_group_count=groups,
         dimension_numbers=("NCDHW", "OIDHW", "NCDHW"),
-        preferred_element_type=jnp.float32,
-    ).astype(x.dtype)
+        preferred_element_type=acc,
+    ).astype(dtype)
     if ctx.has_input("Bias"):
         out = out + _data(ctx.input("Bias")).reshape((1, -1, 1, 1, 1))
     ctx.set_output("Output", out)

@@ -16,6 +16,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..core.lod import LoDArray
+from .. import amp
 from ..core.registry import register_op
 
 
@@ -37,16 +38,21 @@ def conv2d_kernel(ctx):
     pad = _pair(ctx.attr("paddings", (0, 0)))
     dil = _pair(ctx.attr("dilations", (1, 1)))
     groups = ctx.attr("groups", 1)
+    dtype = x.dtype
+    xc, wc = amp.cast_inputs(ctx, x, w)
+    # under amp the conv runs bf16→bf16 (MXU accumulates f32 internally);
+    # a mixed preferred_element_type would break conv's VJP transpose rule
+    acc = jnp.float32 if xc.dtype == jnp.float32 else None
     out = jax.lax.conv_general_dilated(
-        x,
-        w,
+        xc,
+        wc,
         window_strides=stride,
         padding=[(pad[0], pad[0]), (pad[1], pad[1])],
         rhs_dilation=dil,
         feature_group_count=groups,
         dimension_numbers=("NCHW", "OIHW", "NCHW"),
-        preferred_element_type=jnp.float32,
-    ).astype(x.dtype)
+        preferred_element_type=acc,
+    ).astype(dtype)
     if ctx.has_input("Bias"):
         out = out + ctx.input("Bias").reshape((1, -1, 1, 1))
     ctx.set_output("Output", out)
@@ -59,14 +65,18 @@ def conv2d_transpose_kernel(ctx):
     w = ctx.input("Filter")  # [in_c, out_c, kh, kw]
     stride = _pair(ctx.attr("strides", (1, 1)))
     pad = _pair(ctx.attr("paddings", (0, 0)))
+    dtype = x.dtype
+    xc, wc = amp.cast_inputs(ctx, x, jnp.transpose(w, (1, 0, 2, 3)))
+    acc = jnp.float32 if xc.dtype == jnp.float32 else None
     out = jax.lax.conv_transpose(
-        x,
-        jnp.transpose(w, (1, 0, 2, 3)),
+        xc,
+        wc,
         strides=stride,
         padding=[(pad[0], pad[0]), (pad[1], pad[1])],
         dimension_numbers=("NCHW", "OIHW", "NCHW"),
         transpose_kernel=True,
-    )
+        preferred_element_type=acc,
+    ).astype(dtype)
     ctx.set_output("Output", out)
 
 
