@@ -15,10 +15,12 @@ from .misc import *  # noqa: F401,F403
 from .misc import __all__ as _misc_all
 from .nn import *  # noqa: F401,F403
 from .nn import __all__ as _nn_all
+from .recurrent import *  # noqa: F401,F403
+from .recurrent import __all__ as _rec_all
 from .sequence import *  # noqa: F401,F403
 from .sequence import __all__ as _seq_all
 
 __all__ = (
     list(_nn_all) + list(_seq_all) + list(_att_all) + list(_crf_all)
-    + list(_ctc_all) + list(_misc_all) + list(_det_all)
+    + list(_ctc_all) + list(_misc_all) + list(_det_all) + list(_rec_all)
 )

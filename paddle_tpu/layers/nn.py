@@ -44,6 +44,8 @@ __all__ = [
     "elementwise_div",
     "scale",
     "cast",
+    "fill_constant",
+    "increment",
     "topk",
     "argmax",
     "lrn",
@@ -474,6 +476,34 @@ def cast(x, dtype):
     helper.append_op(
         type="cast", inputs={"X": [x]}, outputs={"Out": [out]},
         attrs={"dtype": np.dtype(dtype).name},
+    )
+    return out
+
+
+def fill_constant(shape, dtype, value):
+    """Reference: fluid layers fill_constant (operators/fill_constant_op.cc)."""
+    helper = LayerHelper("fill_constant")
+    out = helper.create_tmp_variable(np.dtype(dtype), tuple(shape))
+    helper.append_op(
+        type="fill_constant",
+        inputs={},
+        outputs={"Out": [out]},
+        attrs={
+            "shape": list(shape),
+            "dtype": np.dtype(dtype).name,
+            "value": value,
+        },
+    )
+    return out
+
+
+def increment(x, value=1.0):
+    """Reference: operators/increment_op.cc."""
+    helper = LayerHelper("increment")
+    out = helper.create_tmp_variable(x.dtype, x.shape, x.lod_level)
+    helper.append_op(
+        type="increment", inputs={"X": [x]}, outputs={"Out": [out]},
+        attrs={"step": value},
     )
     return out
 

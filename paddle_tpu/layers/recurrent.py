@@ -1,0 +1,243 @@
+"""recurrent_group / StaticRNN: arbitrary per-timestep sub-network.
+
+Reference: the Gen-1 `recurrent_group` DSL (trainer_config_helpers/layers.py
+recurrent_group, with `memory()` boot/linkage) executed by
+RecurrentGradientMachine (gserver/gradientmachines/RecurrentGradientMachine.h:32
+— per-timestep cloned frames :428, cross-frame memory links :342), and the
+Fluid `StaticRNN` (python/paddle/v2/fluid/layers/control_flow.py).
+
+TPU design: the step body is authored as a sub-block of the program IR; the
+`recurrent_group` op kernel traces that block into a `lax.scan` body over the
+time-major dense form of the ragged inputs (LoDArray.to_batch). Memories are
+scan carries, frozen past each sequence's end by the validity mask, so the
+final carry equals each sequence's last-step state exactly as the reference's
+frame machinery produces. Parameters and any enclosing-scope values are
+closed over (the analogue of the reference sharing one parameter set across
+frames). The whole group stays inside the single jitted program, so XLA
+fuses the step body and the backward pass is jax.grad through the scan.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Any, List, Optional, Tuple
+
+import numpy as np
+
+from ..core.program import Variable, unique_name
+from .helper import LayerHelper
+
+__all__ = ["RecurrentGroup", "StaticRNN", "recurrent_group"]
+
+
+class _Memory:
+    def __init__(self, inner: Variable, boot: Optional[Variable], shape, init_value):
+        self.inner = inner
+        self.boot = boot
+        self.shape = tuple(shape or ())
+        self.init_value = float(init_value)
+        self.update: Optional[Variable] = None
+
+
+class RecurrentGroup:
+    """Build a per-timestep sub-network over ragged sequence inputs.
+
+    Usage::
+
+        rnn = pt.layers.RecurrentGroup()
+        with rnn.step():
+            x_t = rnn.step_input(seq)            # [B, D] slice at step t
+            h_prev = rnn.memory(shape=[H])       # carried state, boot 0
+            h = pt.layers.fc(pt.layers.concat([x_t, h_prev], axis=1),
+                             size=H, act="tanh")
+            rnn.update_memory(h_prev, h)
+            rnn.step_output(h)
+        out_seq = rnn()                          # LoD sequence of h
+
+    Memories may boot from a dense [B, ...] variable (e.g. an encoder's
+    last state) via ``rnn.memory(init=enc_last)``. Values from the
+    enclosing scope (parameters, projected encoder states, ...) are usable
+    inside the step directly — no declaration needed (`static_input` is
+    kept for reference API parity and is the identity).
+    """
+
+    BEFORE, IN, AFTER = 0, 1, 2
+
+    def __init__(
+        self,
+        is_reverse: bool = False,
+        max_len: Optional[int] = None,
+        name=None,
+    ):
+        self.helper = LayerHelper("recurrent_group", name=name)
+        self.is_reverse = is_reverse
+        self.max_len = max_len
+        self._status = self.BEFORE
+        self._block = None
+        self._seq_pairs: List[Tuple[Variable, Variable]] = []  # (outer, inner)
+        self._memories: List[_Memory] = []
+        self._step_outputs: List[Variable] = []
+        self.outputs: List[Variable] = []
+        self.final_memories: List[Variable] = []
+
+    # -- build phase ---------------------------------------------------------
+    @contextlib.contextmanager
+    def step(self):
+        if self._status != self.BEFORE:
+            raise RuntimeError("step() may only be entered once")
+        prog = self.helper.main_program
+        with prog.block_guard() as b:
+            self._block = b
+            self._status = self.IN
+            yield
+            self._status = self.AFTER
+        self._complete()
+
+    def _require_in_step(self, what: str):
+        if self._status != self.IN:
+            raise RuntimeError(f"{what} must be called inside rnn.step()")
+
+    def step_input(self, seq: Variable) -> Variable:
+        """Declare a ragged sequence input; returns its per-step [B, ...] slice."""
+        self._require_in_step("step_input")
+        if seq.lod_level < 1:
+            raise ValueError(f"step_input needs a sequence (lod_level>=1): {seq.name}")
+        inner = self._block.create_var(
+            unique_name(f"{self.helper.name}.in"), tuple(seq.shape), seq.dtype
+        )
+        self._seq_pairs.append((seq, inner))
+        return inner
+
+    def static_input(self, var: Variable) -> Variable:
+        """Reference parity (StaticInput): enclosing-scope values are already
+
+        visible inside the step body, so this is the identity."""
+        return var
+
+    def memory(
+        self,
+        init: Optional[Variable] = None,
+        shape=None,
+        init_value: float = 0.0,
+        dtype=np.float32,
+    ) -> Variable:
+        """Declare carried state. `init`: dense [B, ...] boot variable
+
+        (reference: memory(boot_layer=...)); else zeros/`init_value` of
+        [B] + shape."""
+        self._require_in_step("memory")
+        if init is None and shape is None:
+            raise ValueError("memory() needs either init= or shape=")
+        # declared var shape carries the batch dim; `shape` is feature dims
+        var_shape = (
+            tuple(init.shape) if init is not None else (-1,) + tuple(shape)
+        )
+        idtype = init.dtype if init is not None else dtype
+        inner = self._block.create_var(
+            unique_name(f"{self.helper.name}.mem"), var_shape, idtype
+        )
+        self._memories.append(_Memory(inner, init, shape or (), init_value))
+        return inner
+
+    def update_memory(self, mem: Variable, new: Variable) -> None:
+        self._require_in_step("update_memory")
+        for m in self._memories:
+            if m.inner.name == mem.name:
+                if m.update is not None:
+                    raise ValueError(f"memory {mem.name} updated twice")
+                m.update = new
+                return
+        raise ValueError(f"{mem.name} is not a memory of this group")
+
+    def step_output(self, var: Variable) -> None:
+        self._require_in_step("step_output")
+        self._step_outputs.append(var)
+
+    output = step_output
+
+    # -- completion ----------------------------------------------------------
+    def _complete(self):
+        if not self._seq_pairs:
+            raise ValueError("recurrent_group needs at least one step_input")
+        for m in self._memories:
+            if m.update is None:
+                raise ValueError(f"memory {m.inner.name} never updated")
+        if not self._step_outputs:
+            raise ValueError("recurrent_group needs at least one step_output")
+        helper = self.helper
+        parent = helper.block  # after rollback: the enclosing block
+        ref = self._seq_pairs[0][0]
+        for v in self._step_outputs:
+            self.outputs.append(
+                parent.create_var(
+                    unique_name(f"{helper.name}.out"),
+                    tuple(v.shape),
+                    v.dtype,
+                    lod_level=ref.lod_level,
+                )
+            )
+        for m in self._memories:
+            self.final_memories.append(
+                parent.create_var(
+                    unique_name(f"{helper.name}.final"),
+                    tuple(m.inner.shape),
+                    m.inner.dtype,
+                )
+            )
+        boot_vars = [m.boot for m in self._memories if m.boot is not None]
+        parent.append_op(
+            "recurrent_group",
+            inputs={
+                "Seq": [o.name for o, _ in self._seq_pairs],
+                "Boot": [v.name for v in boot_vars],
+            },
+            outputs={
+                "Out": [v.name for v in self.outputs],
+                "FinalMem": [v.name for v in self.final_memories],
+            },
+            attrs={
+                "sub_block": self._block.idx,
+                "seq_inner": [i.name for _, i in self._seq_pairs],
+                "mem_inner": [m.inner.name for m in self._memories],
+                "mem_update": [m.update.name for m in self._memories],
+                "mem_has_boot": [m.boot is not None for m in self._memories],
+                "mem_shape": [list(m.shape) for m in self._memories],
+                "mem_init_value": [m.init_value for m in self._memories],
+                "mem_dtype": [
+                    np.dtype(m.inner.dtype).name for m in self._memories
+                ],
+                "out_inner": [v.name for v in self._step_outputs],
+                "is_reverse": self.is_reverse,
+                "max_len": self.max_len,
+            },
+        )
+
+    def __call__(self):
+        if self._status != self.AFTER:
+            raise RuntimeError("call after the step() block has closed")
+        return self.outputs[0] if len(self.outputs) == 1 else tuple(self.outputs)
+
+    def get_final_memory(self, idx: int = 0) -> Variable:
+        """Dense [B, ...] last-step value of the idx-th declared memory."""
+        return self.final_memories[idx]
+
+
+StaticRNN = RecurrentGroup  # fluid name for the same machinery
+
+
+def recurrent_group(step_fn, inputs, is_reverse: bool = False, max_len=None):
+    """Functional wrapper (Gen-1 `recurrent_group(step, input)` shape):
+
+    `step_fn(*step_slices, rnn)` receives per-step slices and the group
+    object (for memory/update_memory) and returns the step output(s)."""
+    if not isinstance(inputs, (list, tuple)):
+        inputs = [inputs]
+    rnn = RecurrentGroup(is_reverse=is_reverse, max_len=max_len)
+    with rnn.step():
+        slices = [rnn.step_input(v) for v in inputs]
+        outs = step_fn(*slices, rnn)
+        if not isinstance(outs, (list, tuple)):
+            outs = [outs]
+        for o in outs:
+            rnn.step_output(o)
+    return rnn()

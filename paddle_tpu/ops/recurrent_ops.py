@@ -1,0 +1,105 @@
+"""recurrent_group op kernel: trace a step sub-block into lax.scan.
+
+Reference: RecurrentGradientMachine::forward
+(gserver/gradientmachines/RecurrentGradientMachine.h:54 — ragged-to-frame
+index maps :374-383, per-timestep frames :428, memory links :342). Instead
+of cloning the step network per frame, the sub-block is traced ONCE as the
+body of a `lax.scan` over the time-major dense form of the inputs; the
+validity mask freezes memories past each sequence's end, reproducing the
+frame machinery's per-sequence last state.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.lod import LoDArray
+from ..core.registry import register_op
+
+
+@register_op("recurrent_group")
+def recurrent_group_kernel(ctx):
+    seqs = ctx.inputs("Seq")
+    boots = ctx.inputs("Boot")
+    if not seqs or not isinstance(seqs[0], LoDArray):
+        raise TypeError("recurrent_group inputs must be LoDArray sequences")
+    first = seqs[0]
+    max_len = ctx.attr("max_len") or first.capacity
+    is_reverse = ctx.attr("is_reverse", False)
+
+    for s in seqs[1:]:
+        # all step inputs must share one LoD layout (the reference's
+        # RecurrentGradientMachine asserts identical sequence layouts)
+        if s.capacity != first.capacity or s.max_seqs != first.max_seqs:
+            raise ValueError(
+                "recurrent_group step inputs have different LoD capacities: "
+                f"{s.capacity}x{s.max_seqs} vs {first.capacity}x{first.max_seqs}"
+            )
+    xs, mask = [], None
+    for s in seqs:
+        b, m = s.to_batch(max_len)  # [T, B, ...], [T, B]
+        xs.append(b)
+        # AND of all masks: if lengths disagree (checkable only at runtime),
+        # a token counts only where every input has one
+        mask = m if mask is None else jnp.logical_and(mask, m)
+    B = first.max_seqs
+
+    seq_inner = list(ctx.attr("seq_inner"))
+    mem_inner = list(ctx.attr("mem_inner"))
+    mem_update = list(ctx.attr("mem_update"))
+    mem_has_boot = list(ctx.attr("mem_has_boot"))
+    mem_shape = [tuple(s) for s in ctx.attr("mem_shape")]
+    mem_init = list(ctx.attr("mem_init_value"))
+    mem_dtype = list(ctx.attr("mem_dtype"))
+    out_inner = list(ctx.attr("out_inner"))
+
+    carries = []
+    boot_it = iter(boots)
+    for has_boot, shape, init, dt in zip(
+        mem_has_boot, mem_shape, mem_init, mem_dtype
+    ):
+        if has_boot:
+            bv = next(boot_it)
+            bv = bv.data if isinstance(bv, LoDArray) else bv
+            if bv.shape[0] != B:
+                raise ValueError(
+                    f"memory boot batch {bv.shape[0]} != sequence batch {B}"
+                )
+            carries.append(bv)
+        else:
+            carries.append(jnp.full((B,) + shape, init, jnp.dtype(dt)))
+
+    block = ctx.executor.program.blocks[ctx.attr("sub_block")]
+    outer_env = dict(ctx.env)  # closure: params, statics, @RNG@/@AMP@
+
+    if is_reverse:
+        xs = [jnp.flip(x, axis=0) for x in xs]
+        mask = jnp.flip(mask, axis=0)
+
+    def body(carry, step):
+        step_xs, m = step  # tuple of [B, ...], [B]
+        env = dict(outer_env)
+        for name, x in zip(seq_inner, step_xs):
+            env[name] = x
+        for name, c in zip(mem_inner, carry):
+            env[name] = c
+        ctx.executor.run_ops(block.ops, env, dict(env), block)
+        new_carry = tuple(
+            jnp.where(m.reshape((B,) + (1,) * (env[u].ndim - 1)), env[u], c)
+            for u, c in zip(mem_update, carry)
+        )
+        outs = tuple(env[o] for o in out_inner)
+        return new_carry, outs
+
+    final, outs = jax.lax.scan(body, tuple(carries), (tuple(xs), mask))
+
+    if is_reverse:
+        outs = tuple(jnp.flip(o, axis=0) for o in outs)
+        mask = jnp.flip(mask, axis=0)
+
+    for i, o in enumerate(outs):
+        ctx.set_output("Out", LoDArray.from_batch(o, mask, first), i)
+    for i, f in enumerate(final):
+        if i < len(ctx.op.outputs.get("FinalMem", [])):
+            ctx.set_output("FinalMem", f, i)

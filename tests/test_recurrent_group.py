@@ -1,0 +1,210 @@
+"""recurrent_group / StaticRNN tests.
+
+Reference analogues: gserver/tests/test_RecurrentGradientMachine.cpp and
+test_RecurrentLayer.cpp — a hand-built step network must match a plain
+per-sequence loop (the dual-implementation oracle, SURVEY.md §4.2), carry
+memories across frames, boot memories from another layer's output, and
+train (grads through the frames into shared parameters).
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.core.lod import LoDArray
+
+
+def _lod(seqs, dtype=np.float32, **kw):
+    return LoDArray.from_sequences([np.asarray(s, dtype) for s in seqs], **kw)
+
+
+def _np_rnn(seqs, w, b, reverse=False):
+    """Plain-python oracle: h_t = tanh([x_t, h_{t-1}] @ w + b)."""
+    outs = []
+    H = w.shape[1]
+    for s in seqs:
+        s = list(s)[::-1] if reverse else list(s)
+        h = np.zeros((H,), np.float32)
+        hs = []
+        for x in s:
+            h = np.tanh(np.concatenate([np.asarray(x, np.float32), h]) @ w + b)
+            hs.append(h)
+        outs.append(hs[::-1] if reverse else hs)
+    return outs
+
+
+def _build_group(D, H, reverse=False):
+    x = pt.layers.data("x", shape=[-1, D], lod_level=1, append_batch_size=False)
+    rnn = pt.layers.RecurrentGroup(is_reverse=reverse, max_len=8)
+    with rnn.step():
+        x_t = rnn.step_input(x)
+        h_prev = rnn.memory(shape=[H])
+        h = pt.layers.fc(
+            pt.layers.concat([x_t, h_prev], axis=1), size=H, act="tanh"
+        )
+        rnn.update_memory(h_prev, h)
+        rnn.step_output(h)
+    return rnn
+
+
+@pytest.mark.parametrize("reverse", [False, True])
+def test_recurrent_group_matches_numpy(reverse):
+    D, H = 3, 4
+    rnn = _build_group(D, H, reverse)
+    out = rnn()
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    rng = np.random.RandomState(0)
+    seqs = [rng.randn(5, D), rng.randn(2, D), rng.randn(3, D)]
+    (got,) = exe.run(
+        feed={"x": _lod(seqs, bucket=16)}, fetch_list=[out], return_numpy=False
+    )
+    scope = pt.global_scope()
+    params = sorted(
+        v.name for v in pt.default_main_program().parameters()
+    )
+    w = np.asarray(scope.get([p for p in params if ".w" in p][0]))
+    b = np.asarray(scope.get([p for p in params if ".b" in p][0]))
+    want = _np_rnn(seqs, w, b, reverse)
+    data = np.asarray(got.data)
+    off = 0
+    for s_want in want:
+        for h_want in s_want:
+            np.testing.assert_allclose(data[off], h_want, atol=1e-5)
+            off += 1
+
+
+def test_final_memory_is_last_state():
+    D, H = 2, 3
+    rnn = _build_group(D, H)
+    out = rnn()
+    final = rnn.get_final_memory(0)
+    last = pt.layers.sequence_last_step(out)
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    rng = np.random.RandomState(1)
+    seqs = [rng.randn(4, D), rng.randn(1, D)]
+    fin, lst = exe.run(
+        feed={"x": _lod(seqs, bucket=8)}, fetch_list=[final, last]
+    )
+    np.testing.assert_allclose(fin[:2], lst[:2], atol=1e-6)
+
+
+def test_memory_boot_from_variable():
+    """Decoder-style: memory booted from a dense per-sequence vector."""
+    D, H = 2, 3
+    x = pt.layers.data("x", shape=[-1, D], lod_level=1, append_batch_size=False)
+    boot = pt.layers.data("boot", shape=[-1, H], append_batch_size=False)
+    rnn = pt.layers.RecurrentGroup(max_len=8)
+    with rnn.step():
+        x_t = rnn.step_input(x)
+        h_prev = rnn.memory(init=boot)
+        h = pt.layers.fc(
+            pt.layers.concat([x_t, h_prev], axis=1), size=H, act="tanh"
+        )
+        rnn.update_memory(h_prev, h)
+        rnn.step_output(h)
+    out = rnn()
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    rng = np.random.RandomState(2)
+    seqs = [rng.randn(3, D), rng.randn(2, D)]
+    lod = _lod(seqs, bucket=8)
+    boot_v = rng.randn(lod.max_seqs, H).astype(np.float32)
+    (got,) = exe.run(
+        feed={"x": lod, "boot": boot_v}, fetch_list=[out], return_numpy=False
+    )
+    scope = pt.global_scope()
+    params = sorted(v.name for v in pt.default_main_program().parameters())
+    w = np.asarray(scope.get([p for p in params if ".w" in p][0]))
+    b = np.asarray(scope.get([p for p in params if ".b" in p][0]))
+    data = np.asarray(got.data)
+    off = 0
+    for i, s in enumerate(seqs):
+        h = boot_v[i]
+        for xrow in s:
+            h = np.tanh(np.concatenate([xrow.astype(np.float32), h]) @ w + b)
+            np.testing.assert_allclose(data[off], h, atol=1e-5)
+            off += 1
+
+
+def test_functional_wrapper():
+    D, H = 2, 3
+    x = pt.layers.data("x", shape=[-1, D], lod_level=1, append_batch_size=False)
+
+    def step(x_t, rnn):
+        h_prev = rnn.memory(shape=[H])
+        h = pt.layers.fc(
+            pt.layers.concat([x_t, h_prev], axis=1), size=H, act="tanh"
+        )
+        rnn.update_memory(h_prev, h)
+        return h
+
+    out = pt.layers.recurrent_group(step, x, max_len=8)
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    (got,) = exe.run(
+        feed={"x": _lod([np.ones((2, D))], bucket=8)},
+        fetch_list=[out],
+        return_numpy=False,
+    )
+    assert np.asarray(got.data).shape[1] == H
+
+
+def test_int_memory_dtype_respected():
+    """A boot-less memory with dtype=int32 carries integers (e.g. a step
+
+    counter in a decoder)."""
+    x = pt.layers.data("x", shape=[-1, 2], lod_level=1, append_batch_size=False)
+    rnn = pt.layers.RecurrentGroup(max_len=8)
+    with rnn.step():
+        x_t = rnn.step_input(x)
+        cnt_prev = rnn.memory(shape=[1], dtype=np.int32)
+        cnt = pt.layers.elementwise_add(
+            cnt_prev, pt.layers.fill_constant([1, 1], np.int32, 1)
+        )
+        rnn.update_memory(cnt_prev, cnt)
+        rnn.step_output(pt.layers.cast(cnt, np.float32))
+    out = rnn()
+    final = rnn.get_final_memory(0)
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    (fin,) = exe.run(
+        feed={"x": _lod([np.zeros((3, 2)), np.zeros((1, 2))], bucket=8)},
+        fetch_list=[final],
+    )
+    assert fin.dtype == np.int32
+    assert fin[0, 0] == 3 and fin[1, 0] == 1
+
+
+def test_recurrent_group_trains():
+    """Grads flow through the scanned frames into the shared parameters."""
+    D, H = 4, 8
+    x = pt.layers.data("x", shape=[-1, D], lod_level=1, append_batch_size=False)
+    label = pt.layers.data("label", shape=[-1, 1], dtype=np.int32,
+                           append_batch_size=False)
+    rnn = _build_group(D, H)
+    out = rnn()
+    last = pt.layers.sequence_last_step(out)
+    logits = pt.layers.fc(last, size=2)
+    loss = pt.layers.mean(pt.layers.softmax_with_cross_entropy(logits, label))
+    pt.optimizer.Adam(learning_rate=0.05).minimize(loss)
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    rng = np.random.RandomState(3)
+    # class = sign of the mean of the sequence's first feature
+    seqs = [rng.randn(rng.randint(2, 6), D) for _ in range(8)]
+    labels = np.array(
+        [[int(s[:, 0].mean() > 0)] for s in seqs], np.int32
+    )
+    lab = np.zeros((8, 1), np.int32)
+    lab[: len(labels)] = labels
+    lod = _lod(seqs, bucket=64, max_seqs=8)
+    losses = []
+    for _ in range(30):
+        (l,) = exe.run(
+            feed={"x": lod, "label": lab},
+            fetch_list=[loss],
+        )
+        losses.append(float(l))
+    assert losses[-1] < losses[0] * 0.7, losses[::10]
