@@ -69,6 +69,11 @@ class RecurrentGroup:
         max_len: Optional[int] = None,
         name=None,
     ):
+        # max_len bounds the scan length. None (default) uses the input's
+        # full flat capacity — never truncates. An explicit max_len is a
+        # performance bucket: sequences LONGER than it are TRUNCATED — steps
+        # past max_len don't run, their output tokens stay zero, and the
+        # final memory is the state at step max_len.
         self.helper = LayerHelper("recurrent_group", name=name)
         self.is_reverse = is_reverse
         self.max_len = max_len

@@ -79,6 +79,8 @@ def resnet_cifar10(input, class_dim=10, depth=32, is_test=False):
 def vgg(input, class_dim=1000, depth=16, is_test=False):
     """VGG-16/19 with BN (reference: benchmark/paddle/image/vgg.py)."""
     cfg = {
+        11: [1, 1, 2, 2, 2],
+        13: [2, 2, 2, 2, 2],
         16: [2, 2, 3, 3, 3],
         19: [2, 2, 4, 4, 4],
     }[depth]

@@ -287,9 +287,11 @@ def assign_kernel(ctx):
 
 @register_op("increment")
 def increment_kernel(ctx):
-    x = _data(ctx.input("X"))
+    x_in = ctx.input("X")
+    x = _data(x_in)
     # cast the step to x's dtype: int counters must stay ints
-    ctx.set_output("Out", x + jnp.asarray(ctx.attr("step", 1.0), dtype=x.dtype))
+    out = x + jnp.asarray(ctx.attr("step", 1.0), dtype=x.dtype)
+    ctx.set_output("Out", _like(x_in, out))
 
 
 @register_op("argmax")

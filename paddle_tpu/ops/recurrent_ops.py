@@ -73,13 +73,25 @@ def recurrent_group_kernel(ctx):
     block = ctx.executor.program.blocks[ctx.attr("sub_block")]
     outer_env = dict(ctx.env)  # closure: params, statics, @RNG@/@AMP@
 
+    # per-group RNG stream: consume one counter from the outer stream, then
+    # fold the timestep in so each frame draws fresh randomness (dropout in
+    # the step body gets a new mask per t, matching per-frame semantics)
+    base_key = jax.random.fold_in(
+        outer_env["@RNG@"], outer_env.get("@RNG_COUNTER@", 0)
+    )
+    ctx.env["@RNG_COUNTER@"] = outer_env.get("@RNG_COUNTER@", 0) + 1
+
     if is_reverse:
         xs = [jnp.flip(x, axis=0) for x in xs]
         mask = jnp.flip(mask, axis=0)
 
+    t_idx = jnp.arange(mask.shape[0], dtype=jnp.int32)
+
     def body(carry, step):
-        step_xs, m = step  # tuple of [B, ...], [B]
+        step_xs, m, t = step  # tuple of [B, ...], [B], scalar t
         env = dict(outer_env)
+        env["@RNG@"] = jax.random.fold_in(base_key, t)
+        env["@RNG_COUNTER@"] = 0
         for name, x in zip(seq_inner, step_xs):
             env[name] = x
         for name, c in zip(mem_inner, carry):
@@ -92,7 +104,7 @@ def recurrent_group_kernel(ctx):
         outs = tuple(env[o] for o in out_inner)
         return new_carry, outs
 
-    final, outs = jax.lax.scan(body, tuple(carries), (tuple(xs), mask))
+    final, outs = jax.lax.scan(body, tuple(carries), (tuple(xs), mask, t_idx))
 
     if is_reverse:
         outs = tuple(jnp.flip(o, axis=0) for o in outs)

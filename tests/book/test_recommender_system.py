@@ -1,0 +1,92 @@
+"""Book 05: recommender system on MovieLens.
+
+Reference acceptance test: python/paddle/v2/fluid/tests/book/
+test_recommender_system.py — dual-tower model: user features (id, gender,
+age, job embeddings → fc) vs movie features (id embedding, sum-pooled
+category embeddings, conv-pooled title sequence → fc), fused by cos_sim
+scaled to the 5-point rating scale, square-error regression on the score.
+"""
+
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu.core.lod import LoDArray
+from paddle_tpu.data import batch, shuffle
+from paddle_tpu.data.datasets import movielens
+
+EMB = 16
+
+
+def _user_tower():
+    uid = pt.layers.data("uid", shape=[1], dtype=np.int32)
+    gender = pt.layers.data("gender", shape=[1], dtype=np.int32)
+    age = pt.layers.data("age", shape=[1], dtype=np.int32)
+    job = pt.layers.data("job", shape=[1], dtype=np.int32)
+    feats = [
+        pt.layers.embedding(uid, size=[movielens.max_user_id() + 1, EMB]),
+        pt.layers.embedding(gender, size=[2, EMB // 2]),
+        pt.layers.embedding(age, size=[len(movielens.age_table), EMB // 2]),
+        pt.layers.embedding(job, size=[movielens.max_job_id() + 1, EMB // 2]),
+    ]
+    flat = [pt.layers.reshape(f, (-1, f.shape[-1])) for f in feats]
+    return pt.layers.fc(pt.layers.concat(flat, axis=1), size=32, act="tanh")
+
+
+def _movie_tower():
+    mid = pt.layers.data("mid", shape=[1], dtype=np.int32)
+    cats = pt.layers.data("cats", shape=[-1], dtype=np.int32, lod_level=1,
+                          append_batch_size=False)
+    title = pt.layers.data("title", shape=[-1], dtype=np.int32, lod_level=1,
+                           append_batch_size=False)
+    mid_emb = pt.layers.embedding(mid, size=[movielens.max_movie_id() + 1, EMB])
+    mid_flat = pt.layers.reshape(mid_emb, (-1, EMB))
+    cat_emb = pt.layers.embedding(
+        cats, size=[len(movielens.movie_categories()), EMB // 2]
+    )
+    cat_pool = pt.layers.sequence_pool(cat_emb, "sum")
+    title_emb = pt.layers.embedding(
+        title, size=[len(movielens.get_movie_title_dict()), EMB]
+    )
+    title_pool = pt.layers.sequence_pool(title_emb, "average")
+    return pt.layers.fc(
+        pt.layers.concat([mid_flat, cat_pool, title_pool], axis=1),
+        size=32,
+        act="tanh",
+    )
+
+
+def test_recommender_system():
+    usr = _user_tower()
+    mov = _movie_tower()
+    score = pt.layers.data("score", shape=[1])
+    sim = pt.layers.cos_sim(usr, mov, scale=5.0)
+    cost = pt.layers.mean(pt.layers.square_error_cost(sim, score))
+    pt.optimizer.Adam(learning_rate=5e-3).minimize(cost)
+
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+
+    reader = batch(shuffle(movielens.train(), 512, seed=0), 32, drop_last=True)
+    losses = []
+    for _pass in range(3):
+        for data in reader():
+            n = len(data)
+            feed = {
+                "uid": np.array([[d[0]] for d in data], np.int32),
+                "gender": np.array([[d[1]] for d in data], np.int32),
+                "age": np.array([[d[2]] for d in data], np.int32),
+                "job": np.array([[d[3]] for d in data], np.int32),
+                "mid": np.array([[d[4]] for d in data], np.int32),
+                "cats": LoDArray.from_sequences(
+                    [np.array(d[5], np.int32) for d in data],
+                    bucket=256, max_seqs=n),
+                "title": LoDArray.from_sequences(
+                    [np.array(d[6], np.int32) for d in data],
+                    bucket=256, max_seqs=n),
+                "score": np.array([[d[7]] for d in data], np.float32),
+            }
+            (l,) = exe.run(feed=feed, fetch_list=[cost])
+            losses.append(float(l))
+    k = max(1, len(losses) // 5)
+    assert np.mean(losses[-k:]) < np.mean(losses[:k]) * 0.6, (
+        np.mean(losses[:k]), np.mean(losses[-k:]))

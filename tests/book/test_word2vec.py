@@ -1,0 +1,46 @@
+"""Book 04: word2vec (N-gram language model) on imikolov.
+
+Reference acceptance test: python/paddle/v2/fluid/tests/book/
+test_word2vec.py — 4 context-word shared embeddings → fc → softmax over
+the dictionary; train until the avg cost drops.
+"""
+
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu.data import batch
+from paddle_tpu.data.datasets import imikolov
+from paddle_tpu.models import word2vec_net
+
+N = 5  # n-gram
+
+
+def test_word2vec():
+    word_dict = imikolov.build_dict()
+    dict_size = len(word_dict)
+
+    words = [
+        pt.layers.data(f"w{i}", shape=[1], dtype=np.int32) for i in range(N - 1)
+    ]
+    next_word = pt.layers.data("next", shape=[1], dtype=np.int32)
+    logits = word2vec_net(words, dict_size, emb_dim=32)
+    cost = pt.layers.mean(
+        pt.layers.softmax_with_cross_entropy(logits, next_word)
+    )
+    pt.optimizer.Adam(learning_rate=1e-2).minimize(cost)
+
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+
+    reader = batch(imikolov.train(word_dict, N), 64, drop_last=True)
+    first = last = None
+    for _pass in range(4):
+        for data in reader():
+            arr = np.array(data, np.int32)
+            feed = {f"w{i}": arr[:, i : i + 1] for i in range(N - 1)}
+            feed["next"] = arr[:, N - 1 :]
+            (last,) = exe.run(feed=feed, fetch_list=[cost])
+            first = last if first is None else first
+    assert float(last) < float(first) * 0.8, (first, last)
+    # LM sanity: perplexity well below uniform
+    assert float(last) < np.log(dict_size) * 0.9, (last, np.log(dict_size))
