@@ -21,6 +21,9 @@ Quick start (fit_a_line, reference book/01)::
 
 from . import amp  # noqa: F401
 from .amp import amp_guard  # noqa: F401
+from . import flags  # noqa: F401
+from .flags import FLAGS, define_flag, parse_flags  # noqa: F401
+from . import profiler  # noqa: F401
 from . import core  # noqa: F401
 from . import ops  # noqa: F401  (registers all kernels)
 from . import evaluator  # noqa: F401
@@ -41,6 +44,7 @@ from .core import (  # noqa: F401
     default_main_program,
     default_startup_program,
     global_scope,
+    memory_optimize,
     program_guard,
     reset_default_programs,
     reset_global_scope,

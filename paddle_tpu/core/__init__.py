@@ -5,7 +5,13 @@ see SURVEY.md §2.1 "Fluid IR/runtime".
 """
 
 from .backward import append_backward  # noqa: F401
-from .executor import Executor, Scope, global_scope, reset_global_scope  # noqa: F401
+from .executor import (  # noqa: F401
+    Executor,
+    Scope,
+    global_scope,
+    memory_optimize,
+    reset_global_scope,
+)
 from .lod import LoDArray  # noqa: F401
 from .place import CPUPlace, Place, TPUPlace, default_place, is_tpu_available  # noqa: F401
 from .program import (  # noqa: F401

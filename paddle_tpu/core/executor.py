@@ -27,9 +27,48 @@ import jax.numpy as jnp
 import numpy as np
 
 from . import registry
+from ..flags import FLAGS
 from .lod import LoDArray
 from .place import Place, default_place
 from .program import Program, Variable, default_main_program, grad_var_name
+
+
+# remat policies: "full" recomputes everything in the backward pass;
+# "dots" keeps matmul/conv results (cheap to store, expensive to recompute)
+_REMAT_POLICIES = {
+    "full": None,
+    "dots": jax.checkpoint_policies.dots_saveable,
+    "dots_no_batch": jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+}
+
+
+def memory_optimize(program=None, policy: str = "dots") -> None:
+    """Reference API: fluid memory_optimization_transpiler.memory_optimize
+
+    (liveness-based forward-activation reuse). TPU equivalent: enable
+    rematerialization of the forward slice inside the backward pass."""
+    program = program or default_main_program()
+    if policy not in _REMAT_POLICIES:
+        raise ValueError(
+            f"unknown remat policy {policy!r}; choose from "
+            f"{sorted(_REMAT_POLICIES)}"
+        )
+    program.remat_policy = policy
+
+
+def _check_finite(values: Dict[str, Any]) -> None:
+    bad = []
+    for name, v in values.items():
+        arrs = jax.tree_util.tree_leaves(v)
+        for a in arrs:
+            if hasattr(a, "dtype") and np.issubdtype(np.dtype(a.dtype), np.floating):
+                if not bool(jnp.all(jnp.isfinite(a))):
+                    bad.append(name)
+                    break
+    if bad:
+        raise FloatingPointError(
+            f"check_nan_inf: non-finite values in {sorted(bad)}"
+        )
 
 
 class Scope:
@@ -94,7 +133,19 @@ class _BlockRunner:
                 continue
             kernel = registry.get_kernel(op.type)
             ctx = registry.OpContext(op, env, executor=self, block=block)
-            kernel(ctx)
+            try:
+                kernel(ctx)
+            except Exception as e:
+                # CustomStackTrace parity (utils/CustomStackTrace.h:51):
+                # name the failing op and its I/O so trace errors point at
+                # the model line, not the kernel internals. RuntimeError
+                # (not type(e)) — arbitrary exception ctors don't take a
+                # message string; the original stays chained below.
+                raise RuntimeError(
+                    f"{e}\n  while executing op #{i} {op.type!r} "
+                    f"(block {block.idx}) inputs={op.inputs} "
+                    f"outputs={op.outputs}"
+                ) from e
         return env
 
     def run_block(self, block_idx: int, env: Dict[str, Any]):
@@ -120,6 +171,13 @@ class _BlockRunner:
             return jnp.reshape(loss, ())
 
         pvals = {p: env[p] for p in param_names}
+        policy = getattr(self.program, "remat_policy", None)
+        if policy:
+            # memory_optimization_transpiler parity: the reference reuses
+            # forward activations' memory via liveness analysis
+            # (fluid memory_optimization_transpiler.py); on TPU the same
+            # HBM↔FLOPs trade is jax.checkpoint over the loss closure
+            closure = jax.checkpoint(closure, policy=_REMAT_POLICIES[policy])
         grads = jax.grad(closure)(pvals)
         for p in param_names:
             env[grad_var_name(p)] = grads[p]
@@ -181,6 +239,7 @@ class Executor:
             id(program),
             program.version,
             program.amp_dtype,
+            program.remat_policy,
             _feed_signature(feed),
             tuple(fetch_names),
             tuple(persist_names),
@@ -202,6 +261,14 @@ class Executor:
         )
         with self._device_context():
             fetches, new_state = fn(state, feed, seed)
+        if FLAGS.check_nan_inf:
+            # reference: CheckTensorNANOrInf per op output behind
+            # FLAGS_check_nan_inf (fluid executor.cc:60-72,125-133). Under
+            # whole-program jit the checkable boundary is the run: every
+            # persistable output + fetch (costs a host sync — debug flag).
+            _check_finite(
+                {**new_state, **{n: f for n, f in zip(fetch_names, fetches)}}
+            )
         for n, v in new_state.items():
             scope.set(n, v)
         if return_numpy:

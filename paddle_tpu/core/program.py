@@ -173,6 +173,9 @@ class Program:
         self.random_seed: int = 0
         # mixed-precision compute dtype (None = full f32); see paddle_tpu/amp.py
         self.amp_dtype: Optional[str] = None
+        # rematerialization policy for the backward pass (None = XLA default);
+        # see core/executor.py _run_autodiff and pt.memory_optimize
+        self.remat_policy: Optional[str] = None
 
     def set_amp(self, dtype: Optional[str] = "bfloat16") -> None:
         """Enable/disable bf16 mixed-precision compute for MXU ops.

@@ -1,0 +1,109 @@
+"""Global flag registry (gflags parity).
+
+Reference: paddle/utils/Flags.cpp:18-110 defines ~40 gflags consumed across
+the runtime (use_gpu, trainer_count, beam_size, check_nan_inf behavior via
+FLAGS_check_nan_inf in fluid executor.cc:60-72, log_period, ...). Here:
+a typed registry with env-var overrides (`PT_FLAGS_<NAME>`) and an argv
+parser, read through the `FLAGS` namespace object.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, List, Optional
+
+_REGISTRY: Dict[str, dict] = {}
+
+
+class _Flags:
+    """Attribute access over the registry: `FLAGS.check_nan_inf`."""
+
+    def __getattr__(self, name: str):
+        try:
+            return _REGISTRY[name]["value"]
+        except KeyError:
+            raise AttributeError(f"undefined flag {name!r}") from None
+
+    def __setattr__(self, name: str, value):
+        if name not in _REGISTRY:
+            raise AttributeError(f"undefined flag {name!r}")
+        _REGISTRY[name]["value"] = _coerce(value, _REGISTRY[name]["default"])
+
+
+FLAGS = _Flags()
+
+
+def _coerce(value, default):
+    if isinstance(default, bool):
+        if isinstance(value, str):
+            return value.lower() in ("1", "true", "yes", "on")
+        return bool(value)
+    if default is None:
+        return value
+    return type(default)(value)
+
+
+def define_flag(name: str, default, help: str = "") -> None:
+    """Register a flag; env var PT_FLAGS_<NAME> overrides the default."""
+    value = default
+    env = os.environ.get(f"PT_FLAGS_{name.upper()}")
+    if env is not None:
+        value = _coerce(env, default)
+    _REGISTRY[name] = {"default": default, "value": value, "help": help}
+
+
+def parse_flags(argv: Optional[List[str]] = None) -> List[str]:
+    """Parse --name=value / --name value pairs; returns unconsumed args."""
+    argv = list(argv or [])
+    rest: List[str] = []
+    i = 0
+    while i < len(argv):
+        a = argv[i]
+        if a.startswith("--") and "=" in a:
+            name, val = a[2:].split("=", 1)
+            name = name.replace("-", "_")
+            if name in _REGISTRY:
+                setattr(FLAGS, name, val)
+            else:
+                rest.append(a)
+            i += 1
+            continue
+        name = a[2:].replace("-", "_") if a.startswith("--") else None
+        if name in _REGISTRY:
+            if isinstance(_REGISTRY[name]["default"], bool):
+                # gflags semantics: a bare boolean flag means True; never
+                # consume the next token as its value
+                setattr(FLAGS, name, True)
+            elif i + 1 < len(argv):
+                setattr(FLAGS, name, argv[i + 1])
+                i += 1
+            else:
+                rest.append(a)
+        else:
+            rest.append(a)
+        i += 1
+    return rest
+
+
+def flags_help() -> str:
+    lines = []
+    for name in sorted(_REGISTRY):
+        f = _REGISTRY[name]
+        lines.append(f"--{name} (default {f['default']!r}): {f['help']}")
+    return "\n".join(lines)
+
+
+# -- core flags (the subset of Flags.cpp that survives the TPU redesign) ----
+define_flag("check_nan_inf", False,
+            "after each executor run, verify all persistable outputs are "
+            "finite (reference: FLAGS_check_nan_inf, fluid executor.cc:60)")
+define_flag("seed", 0, "global random seed (0 = nondeterministic)")
+define_flag("log_period", 100, "trainer: log every N batches")
+define_flag("show_param_stats_period", 0,
+            "trainer: dump per-parameter value/gradient stats every N "
+            "batches (reference: TrainerInternal.cpp:81-109); 0 = off")
+define_flag("beam_size", 7, "default beam width for beam-search decode")
+define_flag("save_dir", "./output", "default checkpoint directory")
+define_flag("enable_timers", False,
+            "accumulate REGISTER_TIMER-style stat timers "
+            "(reference: utils/Stat.h, WITH_TIMER)")

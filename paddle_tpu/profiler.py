@@ -1,0 +1,152 @@
+"""Profiling: stat timers, trace contexts, parameter stats.
+
+Reference surface:
+- Gen-1 `REGISTER_TIMER*` RAII macros accumulating into a global StatSet
+  (paddle/utils/Stat.h:63,114,230-242), printed as a table.
+- Fluid profiler: push/pop ranges + python `profiler.profiler()` context
+  (paddle/platform/profiler.h:25-118, fluid/profiler.py).
+- Per-parameter value/grad stats (TrainerInternal.cpp:81-109).
+
+TPU mapping: host-side timers bracket whole jitted steps (per-op host
+timing is meaningless under fusion); deep kernel profiles come from
+`profiler()` which wraps jax.profiler.trace (XProf). `block=True` fences
+with block_until_ready-style sync so a timer measures device work, not
+dispatch."""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from .flags import FLAGS
+
+
+class Stat:
+    __slots__ = ("name", "count", "total", "max")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.count = 0
+        self.total = 0.0
+        self.max = 0.0
+
+    def add(self, dt: float) -> None:
+        self.count += 1
+        self.total += dt
+        self.max = max(self.max, dt)
+
+    @property
+    def avg(self) -> float:
+        return self.total / self.count if self.count else 0.0
+
+
+class StatSet:
+    """Named timer accumulator (reference: StatSet, Stat.h:230)."""
+
+    def __init__(self):
+        self.stats: Dict[str, Stat] = {}
+
+    def get(self, name: str) -> Stat:
+        if name not in self.stats:
+            self.stats[name] = Stat(name)
+        return self.stats[name]
+
+    @contextlib.contextmanager
+    def timer(self, name: str, always: bool = False):
+        """RAII timer (REGISTER_TIMER parity). No-op unless
+        FLAGS.enable_timers or always=True (WITH_TIMER compile gate)."""
+        if not (always or FLAGS.enable_timers):
+            yield
+            return
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.get(name).add(time.perf_counter() - t0)
+
+    def print_all_status(self) -> str:
+        """Formatted table (reference: StatSet::printAllStatus)."""
+        rows = [f"{'name':<30}{'count':>8}{'total(s)':>12}{'avg(ms)':>10}{'max(ms)':>10}"]
+        for name in sorted(self.stats):
+            s = self.stats[name]
+            rows.append(
+                f"{name:<30}{s.count:>8}{s.total:>12.4f}"
+                f"{s.avg * 1e3:>10.3f}{s.max * 1e3:>10.3f}"
+            )
+        out = "\n".join(rows)
+        print(out)
+        return out
+
+    def reset(self) -> None:
+        self.stats.clear()
+
+
+_global_stats = StatSet()
+
+
+def global_stat_set() -> StatSet:
+    return _global_stats
+
+
+def timer(name: str, always: bool = False):
+    return _global_stats.timer(name, always)
+
+
+@contextlib.contextmanager
+def profiler(output_dir: str = "/tmp/paddle_tpu_trace", state: str = "All"):
+    """Deep-trace context (fluid profiler.profiler() parity): wraps
+
+    jax.profiler.trace so kernels show up in XProf/TensorBoard. `state`
+    is accepted for reference API parity ("CPU"/"GPU"/"All")."""
+    import jax
+
+    started = False
+    try:
+        jax.profiler.start_trace(output_dir)
+        started = True
+    except (RuntimeError, NotImplementedError):
+        pass  # tracing unsupported on this backend — degrade to a no-op
+    try:
+        yield
+    finally:
+        if started:
+            try:
+                jax.profiler.stop_trace()
+            except (RuntimeError, NotImplementedError):
+                pass
+
+
+def parameter_stats(
+    program=None, scope=None, grads: Optional[Dict[str, Any]] = None
+) -> Dict[str, Dict[str, float]]:
+    """Per-parameter value/gradient stats (TrainerInternal.cpp:81-109):
+
+    mean/abs-max of each parameter; gradient stats come from `grads`
+    (param name → array, fetched from the step — grad vars are jit
+    temporaries, not scope residents) or, failing that, the scope."""
+    from .core.executor import global_scope
+    from .core.program import default_main_program, grad_var_name
+
+    program = program or default_main_program()
+    scope = scope or global_scope()
+    grads = grads or {}
+    out: Dict[str, Dict[str, float]] = {}
+    for p in program.parameters():
+        if not scope.has(p.name):
+            continue
+        v = np.asarray(scope.get(p.name))
+        d = {"mean": float(v.mean()), "abs_max": float(np.abs(v).max())}
+        g = grad_var_name(p.name)
+        gv = None
+        if p.name in grads:
+            gv = np.asarray(grads[p.name])
+        elif scope.has(g):
+            gv = np.asarray(scope.get(g))
+        if gv is not None:
+            d["grad_mean"] = float(gv.mean())
+            d["grad_abs_max"] = float(np.abs(gv).max())
+        out[p.name] = d
+    return out

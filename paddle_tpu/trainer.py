@@ -24,13 +24,16 @@ from typing import Any, Callable, Dict, List, Optional, Sequence
 import numpy as np
 
 from . import io
+from . import profiler
 from .core.executor import Executor, Scope, global_scope
+from .flags import FLAGS
 from .core.place import Place
 from .core.program import (
     Program,
     Variable,
     default_main_program,
     default_startup_program,
+    grad_var_name,
 )
 from .data.feeder import DataFeeder
 
@@ -182,14 +185,34 @@ class Trainer:
                 if batch_id < skip_until:
                     continue
                 handler(BeginIteration(pass_id, batch_id))
-                feed = feeder.feed(data) if feeder else data
-                outs = self.exe.run(
-                    self.main_program,
-                    feed=feed,
-                    fetch_list=fetch_list,
-                    scope=self.scope,
-                )
+                with profiler.timer("prepareBatchData"):
+                    feed = feeder.feed(data) if feeder else data
+                sp = FLAGS.show_param_stats_period
+                want_stats = bool(sp) and (self.step + 1) % sp == 0
+                step_fetch = list(fetch_list)
+                stat_params = []
+                if want_stats:
+                    # grad vars are jit temporaries, not scope residents —
+                    # fetch them explicitly on stats steps
+                    stat_params = [p.name for p in self.main_program.parameters()]
+                    step_fetch += [grad_var_name(p) for p in stat_params]
+                with profiler.timer("forwardBackward"):
+                    outs = self.exe.run(
+                        self.main_program,
+                        feed=feed,
+                        fetch_list=step_fetch,
+                        scope=self.scope,
+                    )
                 cost = float(np.asarray(outs[0]))
+                if want_stats:
+                    # reference: TrainerInternal.cpp:81-109 param stats dump
+                    grads = dict(zip(stat_params, outs[len(fetch_list):]))
+                    outs = outs[: len(fetch_list)]
+                    for pname, st in profiler.parameter_stats(
+                        self.main_program, self.scope, grads=grads
+                    ).items():
+                        print(f"  param {pname}: " + ", ".join(
+                            f"{k}={v:.4g}" for k, v in st.items()))
                 batch_metrics = {
                     k: float(np.asarray(v))
                     for (k, _), v in zip(metric_items, outs[1:])
