@@ -240,6 +240,7 @@ class Executor:
             program.version,
             program.amp_dtype,
             program.remat_policy,
+            FLAGS.use_fused_rnn,  # trace-affecting flag
             _feed_signature(feed),
             tuple(fetch_names),
             tuple(persist_names),

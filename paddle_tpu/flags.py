@@ -107,3 +107,14 @@ define_flag("save_dir", "./output", "default checkpoint directory")
 define_flag("enable_timers", False,
             "accumulate REGISTER_TIMER-style stat timers "
             "(reference: utils/Stat.h, WITH_TIMER)")
+define_flag("use_fused_rnn", False,
+            "use pallas fused LSTM/GRU sequence kernels when shapes are "
+            "eligible and the backend is TPU (reference: "
+            "hl_lstm_parallel_forward fused CUDA kernels, "
+            "cuda/include/hl_lstm.h:42). Off by default: measured on "
+            "v5e at T=100 B=128 H=512, XLA's lax.scan lowering is ~7% "
+            "faster forward and comparable backward; flip on for shapes "
+            "where the fused kernel wins")
+define_flag("fused_rnn_interpret", False,
+            "testing only: allow the fused RNN kernels in pallas interpret "
+            "mode on non-TPU backends")

@@ -1,0 +1,399 @@
+"""Pallas fused recurrent kernels.
+
+Reference: the hand-written fused CUDA recurrences —
+`hl_lstm_parallel_forward` (cuda/include/hl_lstm.h:42, hl_gpu_lstm.cuh) and
+the GRU equivalents (hl_gpu_gru.cuh) — which keep the recurrent state in
+registers/shared memory and run the whole sequence in one kernel launch.
+
+TPU design: one pallas_call with `grid=(T,)`; the TPU grid runs
+sequentially, so the hidden/cell state lives in VMEM scratch across grid
+steps while each timestep's pre-projected input block is pipelined in from
+HBM automatically by the BlockSpec machinery (double-buffered DMA). The
+per-step h @ W_rec hits the MXU; all gate math fuses on the VPU; the only
+HBM traffic is the x block in and the h block out — the same
+bandwidth-optimality argument as the reference's fused kernels.
+
+Training: `pallas_call` has no automatic VJP, so the fused forward is
+wrapped in `jax.custom_vjp` whose backward re-runs the plain `lax.scan`
+formulation under `jax.vjp` (rematerialized backward — same FLOPs as a
+saved-activation backward plus one forward, no extra HBM residency).
+
+Eligibility (else callers fall back to the scan): sigmoid/tanh gates, no
+peepholes, B multiple of 8, H multiple of 128 (f32 tile constraints).
+Non-TPU backends run the kernel in interpret mode (tests on CPU exercise
+the same code path).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _backend_ok() -> bool:
+    # interpret mode exists for tests; production dispatch must not send
+    # CPU/GPU users through the pure-Python interpreter when lax.scan is
+    # sitting right there (fused_rnn_interpret is the test override)
+    from ..flags import FLAGS
+
+    return jax.default_backend() == "tpu" or FLAGS.fused_rnn_interpret
+
+
+def lstm_supported(B: int, H: int, gate_act, cell_act, cand_act, peep) -> bool:
+    return (
+        peep is None
+        and gate_act == "sigmoid"
+        and cell_act == "tanh"
+        and cand_act == "tanh"
+        and B % 8 == 0
+        and H % 128 == 0
+        and _backend_ok()
+    )
+
+
+def gru_supported(B: int, H: int, gate_act, cand_act) -> bool:
+    return (
+        gate_act == "sigmoid"
+        and cand_act == "tanh"
+        and B % 8 == 0
+        and H % 128 == 0
+        and _backend_ok()
+    )
+
+
+# ------------------------------------------------------------------ LSTM ---
+def _lstm_kernel(
+    x_ref, m_ref, w_ref, h_seq_ref, c_seq_ref, hT_ref, cT_ref, h_s, c_s
+):
+    """One timestep per grid step; h/c persist in VMEM scratch. c_seq is
+
+    emitted as a residual for the hand-written backward kernel."""
+    t = pl.program_id(0)
+
+    @pl.when(t == 0)
+    def _():
+        h_s[:] = jnp.zeros_like(h_s)
+        c_s[:] = jnp.zeros_like(c_s)
+
+    h_prev = h_s[:]
+    c_prev = c_s[:]
+    gates = x_ref[0] + jnp.dot(
+        h_prev, w_ref[:], preferred_element_type=jnp.float32
+    ).astype(x_ref.dtype)
+    H = h_prev.shape[-1]
+    i = jax.nn.sigmoid(gates[:, :H])
+    f = jax.nn.sigmoid(gates[:, H : 2 * H])
+    g = jnp.tanh(gates[:, 2 * H : 3 * H])
+    o = jax.nn.sigmoid(gates[:, 3 * H :])
+    c = f * c_prev + i * g
+    h = o * jnp.tanh(c)
+    m = m_ref[0, 0].astype(h.dtype)[:, None]
+    h = m * h + (1 - m) * h_prev
+    c = m * c + (1 - m) * c_prev
+    h_s[:] = h
+    c_s[:] = c
+    h_seq_ref[0] = h
+    c_seq_ref[0] = c
+
+    @pl.when(t == pl.num_programs(0) - 1)
+    def _():
+        hT_ref[:] = h
+        cT_ref[:] = c
+
+
+def _lstm_pallas_raw(x_tbh, mask, w_rec):
+    T, B, H4 = x_tbh.shape
+    H = H4 // 4
+    dt = x_tbh.dtype
+    return pl.pallas_call(
+        _lstm_kernel,
+        grid=(T,),
+        in_specs=[
+            pl.BlockSpec((1, B, H4), lambda t: (t, 0, 0)),
+            # mask rides as [T, 1, B]: a (1, 1, B) block satisfies the
+            # (sublane, lane) tiling rule for any B (dims equal the array's)
+            pl.BlockSpec((1, 1, B), lambda t: (t, 0, 0)),
+            pl.BlockSpec((H, H4), lambda t: (0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, B, H), lambda t: (t, 0, 0)),
+            pl.BlockSpec((1, B, H), lambda t: (t, 0, 0)),
+            pl.BlockSpec((B, H), lambda t: (0, 0)),
+            pl.BlockSpec((B, H), lambda t: (0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((T, B, H), dt),
+            jax.ShapeDtypeStruct((T, B, H), dt),
+            jax.ShapeDtypeStruct((B, H), dt),
+            jax.ShapeDtypeStruct((B, H), dt),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((B, H), dt),
+            pltpu.VMEM((B, H), dt),
+        ],
+        interpret=_interpret(),
+    )(x_tbh, mask.astype(jnp.float32).reshape(T, 1, B), w_rec)
+
+
+def _lstm_bwd_kernel(
+    gates_ref,  # (1, B, 4H) pre-activation gates at t
+    cprev_ref,  # (1, B, H) c_{t-1}
+    hprev_ref,  # (1, B, H) h_{t-1}
+    dh_seq_ref,  # (1, B, H) output cotangent at t
+    m_ref,  # (1, 1, B)
+    w_ref,  # (H, 4H)
+    dhT_ref,  # (B, H) cotangent of final h
+    dcT_ref,  # (B, H) cotangent of final c
+    dx_ref,  # out (1, B, 4H)
+    dw_ref,  # out (H, 4H)
+    dh_s,  # scratch (B, H): dL/dh_t carry
+    dc_s,  # scratch (B, H): dL/dc_t carry
+    dw_s,  # scratch (H, 4H) f32 accumulator
+):
+    """Reverse-time step: t = T-1-s via the index maps. Gates are
+
+    recomputed OUTSIDE in one batched matmul (h_seq is saved, so gate
+    pre-activations have no sequential dependency); only the dh/dc carry
+    is sequential here."""
+    s = pl.program_id(0)
+
+    @pl.when(s == 0)
+    def _():
+        dh_s[:] = dhT_ref[:]
+        dc_s[:] = dcT_ref[:]
+        dw_s[:] = jnp.zeros_like(dw_s)
+
+    gates = gates_ref[0]
+    H = dh_s.shape[-1]
+    i = jax.nn.sigmoid(gates[:, :H])
+    f = jax.nn.sigmoid(gates[:, H : 2 * H])
+    g = jnp.tanh(gates[:, 2 * H : 3 * H])
+    o = jax.nn.sigmoid(gates[:, 3 * H :])
+    c_prev = cprev_ref[0]
+    h_prev = hprev_ref[0]
+    m = m_ref[0, 0].astype(gates.dtype)[:, None]
+
+    c_raw = f * c_prev + i * g
+    tc = jnp.tanh(c_raw)
+
+    dh_total = dh_seq_ref[0] + dh_s[:]
+    dc_total = dc_s[:]
+    dh_raw = m * dh_total
+    dc_raw = m * dc_total + dh_raw * o * (1 - tc * tc)
+    do_a = dh_raw * tc * o * (1 - o)
+    di_a = dc_raw * g * i * (1 - i)
+    df_a = dc_raw * c_prev * f * (1 - f)
+    dg_a = dc_raw * i * (1 - g * g)
+    dgates = jnp.concatenate([di_a, df_a, dg_a, do_a], axis=1)
+
+    dx_ref[0] = dgates
+    dh_s[:] = (
+        jnp.dot(
+            dgates, w_ref[:].T, preferred_element_type=jnp.float32
+        ).astype(dgates.dtype)
+        + (1 - m) * dh_total
+    )
+    dc_s[:] = dc_raw * f + (1 - m) * dc_total
+    dw_s[:] = dw_s[:] + jnp.dot(
+        h_prev.T, dgates, preferred_element_type=jnp.float32
+    )
+
+    @pl.when(s == pl.num_programs(0) - 1)
+    def _():
+        dw_ref[:] = dw_s[:].astype(dw_ref.dtype)
+
+
+def _lstm_bwd_pallas(x_tbh, mask, w_rec, h_seq, c_seq, dh_seq, dhT, dcT):
+    T, B, H4 = x_tbh.shape
+    H = H4 // 4
+    dt = x_tbh.dtype
+    zeros = jnp.zeros((1, B, H), dt)
+    h_prev_seq = jnp.concatenate([zeros, h_seq[:-1]], axis=0)
+    c_prev_seq = jnp.concatenate([zeros, c_seq[:-1]], axis=0)
+    # all gate pre-activations in ONE batched matmul — no recurrence
+    gates_pre = x_tbh + jnp.einsum(
+        "tbh,hk->tbk", h_prev_seq, w_rec,
+        preferred_element_type=jnp.float32,
+    ).astype(dt)
+    rev = lambda t: (T - 1 - t, 0, 0)  # noqa: E731 — reverse-time index map
+    dx, dw = pl.pallas_call(
+        _lstm_bwd_kernel,
+        grid=(T,),
+        in_specs=[
+            pl.BlockSpec((1, B, H4), rev),
+            pl.BlockSpec((1, B, H), rev),
+            pl.BlockSpec((1, B, H), rev),
+            pl.BlockSpec((1, B, H), rev),
+            pl.BlockSpec((1, 1, B), rev),
+            pl.BlockSpec((H, H4), lambda t: (0, 0)),
+            pl.BlockSpec((B, H), lambda t: (0, 0)),
+            pl.BlockSpec((B, H), lambda t: (0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, B, H4), rev),
+            pl.BlockSpec((H, H4), lambda t: (0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((T, B, H4), dt),
+            jax.ShapeDtypeStruct((H, H4), dt),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((B, H), dt),
+            pltpu.VMEM((B, H), dt),
+            pltpu.VMEM((H, H4), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(
+        gates_pre,
+        c_prev_seq,
+        h_prev_seq,
+        dh_seq,
+        mask.astype(jnp.float32).reshape(T, 1, B),
+        w_rec,
+        dhT,
+        dcT,
+    )
+    return dx, dw
+
+
+def lstm_fused(x_tbh, mask, w_rec, bias=None, reverse=False):
+    """Fused LSTM over the whole sequence (zero-boot, sigmoid/tanh).
+
+    Mirrors lstm_scan's signature subset: optional pre-gate bias and
+    time reversal (flip in, flip the emitted sequence back)."""
+    if bias is not None:
+        x_tbh = x_tbh + bias
+    if reverse:
+        h_seq, last = _lstm_fused_core(x_tbh[::-1], mask[::-1], w_rec)
+        return h_seq[::-1], last
+    return _lstm_fused_core(x_tbh, mask, w_rec)
+
+
+@jax.custom_vjp
+def _lstm_fused_core(x_tbh, mask, w_rec):
+    h_seq, _c_seq, h_T, c_T = _lstm_pallas_raw(x_tbh, mask, w_rec)
+    return h_seq, (h_T, c_T)
+
+
+def _lstm_fwd(x_tbh, mask, w_rec):
+    h_seq, c_seq, h_T, c_T = _lstm_pallas_raw(x_tbh, mask, w_rec)
+    return (h_seq, (h_T, c_T)), (x_tbh, mask, w_rec, h_seq, c_seq)
+
+
+def _lstm_bwd(res, ct):
+    x_tbh, mask, w_rec, h_seq, c_seq = res
+    dh_seq, (dhT, dcT) = ct
+    dx, dw = _lstm_bwd_pallas(
+        x_tbh, mask, w_rec, h_seq, c_seq, dh_seq, dhT, dcT
+    )
+    return dx, None, dw
+
+
+_lstm_fused_core.defvjp(_lstm_fwd, _lstm_bwd)
+
+
+# ------------------------------------------------------------------- GRU ---
+def _gru_kernel(x_ref, m_ref, w_ref, h_seq_ref, hT_ref, h_s):
+    t = pl.program_id(0)
+
+    @pl.when(t == 0)
+    def _():
+        h_s[:] = jnp.zeros_like(h_s)
+
+    h_prev = h_s[:]
+    H = h_prev.shape[-1]
+    xp = x_ref[0]
+    w_ur = w_ref[:, : 2 * H]
+    w_c = w_ref[:, 2 * H :]
+    ur = jax.nn.sigmoid(
+        xp[:, : 2 * H]
+        + jnp.dot(h_prev, w_ur, preferred_element_type=jnp.float32).astype(
+            xp.dtype
+        )
+    )
+    u, r = ur[:, :H], ur[:, H:]
+    c = jnp.tanh(
+        xp[:, 2 * H :]
+        + jnp.dot(
+            r * h_prev, w_c, preferred_element_type=jnp.float32
+        ).astype(xp.dtype)
+    )
+    h = (1 - u) * h_prev + u * c
+    m = m_ref[0, 0].astype(h.dtype)[:, None]
+    h = m * h + (1 - m) * h_prev
+    h_s[:] = h
+    h_seq_ref[0] = h
+
+    @pl.when(t == pl.num_programs(0) - 1)
+    def _():
+        hT_ref[:] = h
+
+
+def _gru_pallas_raw(x_tbh, mask, w_rec):
+    T, B, H3 = x_tbh.shape
+    H = H3 // 3
+    dt = x_tbh.dtype
+    return pl.pallas_call(
+        _gru_kernel,
+        grid=(T,),
+        in_specs=[
+            pl.BlockSpec((1, B, H3), lambda t: (t, 0, 0)),
+            pl.BlockSpec((1, 1, B), lambda t: (t, 0, 0)),
+            pl.BlockSpec((H, H3), lambda t: (0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, B, H), lambda t: (t, 0, 0)),
+            pl.BlockSpec((B, H), lambda t: (0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((T, B, H), dt),
+            jax.ShapeDtypeStruct((B, H), dt),
+        ],
+        scratch_shapes=[pltpu.VMEM((B, H), dt)],
+        interpret=_interpret(),
+    )(x_tbh, mask.astype(jnp.float32).reshape(T, 1, B), w_rec)
+
+
+def gru_fused(x_tbh, mask, w_rec, bias=None, reverse=False):
+    """Fused GRU over the whole sequence (zero-boot, sigmoid/tanh)."""
+    if bias is not None:
+        x_tbh = x_tbh + bias
+    if reverse:
+        h_seq, h_T = _gru_fused_core(x_tbh[::-1], mask[::-1], w_rec)
+        return h_seq[::-1], h_T
+    return _gru_fused_core(x_tbh, mask, w_rec)
+
+
+@jax.custom_vjp
+def _gru_fused_core(x_tbh, mask, w_rec):
+    h_seq, h_T = _gru_pallas_raw(x_tbh, mask, w_rec)
+    return h_seq, h_T
+
+
+def _gru_scan_ref(x_tbh, mask, w_rec):
+    from .rnn_ops import gru_scan
+
+    return gru_scan(x_tbh, mask, w_rec, None)
+
+
+def _gru_fwd(x_tbh, mask, w_rec):
+    return _gru_fused_core(x_tbh, mask, w_rec), (x_tbh, mask, w_rec)
+
+
+def _gru_bwd(res, ct):
+    x_tbh, mask, w_rec = res
+    _, vjp = jax.vjp(lambda x, w: _gru_scan_ref(x, mask, w), x_tbh, w_rec)
+    dx, dw = vjp(ct)
+    return dx, None, dw
+
+
+_gru_fused_core.defvjp(_gru_fwd, _gru_bwd)

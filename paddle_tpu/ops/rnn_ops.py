@@ -24,6 +24,8 @@ import jax.numpy as jnp
 
 from ..core.lod import LoDArray
 from ..core.registry import register_op
+from ..flags import FLAGS
+from . import pallas_kernels
 from .activation_ops import _ACTIVATIONS
 
 
@@ -161,17 +163,29 @@ def dynamic_lstm_kernel(ctx):
         b, peep = b[: w.shape[1]], b[w.shape[1] :]
     max_len = ctx.attr("max_len") or x.capacity
     x_tb, mask = x.to_batch(max_len=max_len)
-    h_seq, (h_T, c_T) = lstm_scan(
-        x_tb,
-        mask,
-        w,
-        b,
-        w_peephole=peep,
-        gate_act=ctx.attr("gate_activation", "sigmoid"),
-        cell_act=ctx.attr("cell_activation", "tanh"),
-        cand_act=ctx.attr("candidate_activation", "tanh"),
-        reverse=ctx.attr("is_reverse", False),
-    )
+    gate_act = ctx.attr("gate_activation", "sigmoid")
+    cell_act = ctx.attr("cell_activation", "tanh")
+    cand_act = ctx.attr("candidate_activation", "tanh")
+    reverse = ctx.attr("is_reverse", False)
+    B, H = x_tb.shape[1], w.shape[0]
+    if FLAGS.use_fused_rnn and pallas_kernels.lstm_supported(
+        B, H, gate_act, cell_act, cand_act, peep
+    ):
+        h_seq, (h_T, c_T) = pallas_kernels.lstm_fused(
+            x_tb, mask, w, bias=b, reverse=reverse
+        )
+    else:
+        h_seq, (h_T, c_T) = lstm_scan(
+            x_tb,
+            mask,
+            w,
+            b,
+            w_peephole=peep,
+            gate_act=gate_act,
+            cell_act=cell_act,
+            cand_act=cand_act,
+            reverse=reverse,
+        )
     ctx.set_output("Hidden", LoDArray.from_batch(h_seq, mask, x))
     if ctx.has_output("LastH"):
         ctx.set_output("LastH", h_T)
@@ -187,15 +201,26 @@ def dynamic_gru_kernel(ctx):
     b = ctx.input("Bias") if ctx.has_input("Bias") else None
     max_len = ctx.attr("max_len") or x.capacity
     x_tb, mask = x.to_batch(max_len=max_len)
-    h_seq, h_T = gru_scan(
-        x_tb,
-        mask,
-        w,
-        b,
-        gate_act=ctx.attr("gate_activation", "sigmoid"),
-        cand_act=ctx.attr("candidate_activation", "tanh"),
-        reverse=ctx.attr("is_reverse", False),
-    )
+    gate_act = ctx.attr("gate_activation", "sigmoid")
+    cand_act = ctx.attr("candidate_activation", "tanh")
+    reverse = ctx.attr("is_reverse", False)
+    B, H = x_tb.shape[1], w.shape[0]
+    if FLAGS.use_fused_rnn and pallas_kernels.gru_supported(
+        B, H, gate_act, cand_act
+    ):
+        h_seq, h_T = pallas_kernels.gru_fused(
+            x_tb, mask, w, bias=b, reverse=reverse
+        )
+    else:
+        h_seq, h_T = gru_scan(
+            x_tb,
+            mask,
+            w,
+            b,
+            gate_act=gate_act,
+            cand_act=cand_act,
+            reverse=reverse,
+        )
     ctx.set_output("Hidden", LoDArray.from_batch(h_seq, mask, x))
     if ctx.has_output("LastH"):
         ctx.set_output("LastH", h_T)

@@ -9,9 +9,9 @@ Reference surface:
 
 TPU mapping: host-side timers bracket whole jitted steps (per-op host
 timing is meaningless under fusion); deep kernel profiles come from
-`profiler()` which wraps jax.profiler.trace (XProf). `block=True` fences
-with block_until_ready-style sync so a timer measures device work, not
-dispatch."""
+`profiler()` which wraps jax.profiler.trace (XProf). Dispatch is async —
+put a host-side read of a result (e.g. `float(np.asarray(cost))`) inside
+the timed block so the timer measures device work, not enqueue time."""
 
 from __future__ import annotations
 

@@ -193,8 +193,19 @@ class Trainer:
                 stat_params = []
                 if want_stats:
                     # grad vars are jit temporaries, not scope residents —
-                    # fetch them explicitly on stats steps
-                    stat_params = [p.name for p in self.main_program.parameters()]
+                    # fetch them explicitly on stats steps. Only params the
+                    # autodiff op actually differentiates have grad vars
+                    # (frozen/unconnected params do not).
+                    trained = set()
+                    for block in self.main_program.blocks:
+                        for op in block.ops:
+                            if op.type == "autodiff":
+                                trained |= set(op.attrs.get("params", ()))
+                    stat_params = [
+                        p.name
+                        for p in self.main_program.parameters()
+                        if p.name in trained
+                    ]
                     step_fetch += [grad_var_name(p) for p in stat_params]
                 with profiler.timer("forwardBackward"):
                     outs = self.exe.run(
@@ -203,7 +214,9 @@ class Trainer:
                         fetch_list=step_fetch,
                         scope=self.scope,
                     )
-                cost = float(np.asarray(outs[0]))
+                    # the d2h read of the cost fences async dispatch, so the
+                    # timer measures device work, not enqueue time
+                    cost = float(np.asarray(outs[0]))
                 if want_stats:
                     # reference: TrainerInternal.cpp:81-109 param stats dump
                     grads = dict(zip(stat_params, outs[len(fetch_list):]))

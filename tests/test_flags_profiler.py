@@ -86,6 +86,26 @@ def test_trainer_param_stats_include_grads(monkeypatch, capsys):
     assert "grad_abs_max" in out and "mean" in out
 
 
+def test_trainer_param_stats_with_frozen_param(monkeypatch, capsys):
+    """A parameter outside minimize()'s slice has no grad var; stats steps
+
+    must not try to fetch one."""
+    monkeypatch.setattr(FLAGS, "show_param_stats_period", 1)
+    x = pt.layers.data("x", shape=[4])
+    y = pt.layers.data("y", shape=[1])
+    pred = pt.layers.fc(x, size=1)
+    frozen = pt.layers.fc(x, size=1)  # built but not part of the loss
+    loss = pt.layers.mean(pt.layers.square_error_cost(pred, y))
+    pt.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    trainer = pt.Trainer(cost=loss)
+
+    def reader():
+        yield {"x": np.ones((4, 4), np.float32), "y": np.ones((4, 1), np.float32)}
+
+    trainer.train(reader, num_passes=1)  # must not raise
+    assert "grad_abs_max" in capsys.readouterr().out
+
+
 def test_profiler_exception_passthrough():
     """An exception inside profiler() propagates unchanged."""
     with pytest.raises(RuntimeError, match="boom"):
