@@ -7,8 +7,9 @@ Baseline: the reference's best published ResNet-50 *training* number,
 (BASELINE.md / benchmark/IntelOptimizedPaddle.md:38-45 — the reference
 has no GPU ResNet number in-tree). vs_baseline = ours / 81.69.
 
-Env overrides: BENCH_BATCH (default 64), BENCH_STEPS (default 16),
-BENCH_AMP (default 1 — bf16 MXU compute with f32 master weights).
+Env overrides: BENCH_BATCH (default 128 — best measured v5e throughput),
+BENCH_STEPS (default 16), BENCH_AMP (default 1 — bf16 MXU compute with
+f32 master weights).
 """
 
 from __future__ import annotations
@@ -45,7 +46,7 @@ def _build_resnet_train(batch):
 
 
 def main():
-    batch = int(os.environ.get("BENCH_BATCH", 64))
+    batch = int(os.environ.get("BENCH_BATCH", 128))
     steps = int(os.environ.get("BENCH_STEPS", 16))
 
     import jax

@@ -26,6 +26,9 @@ __all__ = [
     "ChunkEvaluator",
     "EditDistance",
     "DetectionMAP",
+    "RankAuc",
+    "PnPair",
+    "ValuePrinter",
 ]
 
 
@@ -402,3 +405,153 @@ class DetectionMAP(Evaluator):
         aps = [self._ap(c) for c in range(self.num_classes)]
         aps = [a for a in aps if a is not None]
         return float(np.mean(aps)) if aps else 0.0
+
+
+class RankAuc(Evaluator):
+    """Pairwise ranking AUC over (score, label[, weight]) samples grouped
+
+    by query (reference: RankAucEvaluator, Evaluator.cpp:514 — computes
+    AUC from the label-weighted rank order of scores). Without query ids
+    it reduces to the classic Wilcoxon/AUC statistic like `Auc`, but fed
+    with continuous click/label weights rather than binary labels."""
+
+    name = "rank_auc"
+
+    def __init__(self):
+        self.reset()
+
+    def reset(self) -> None:
+        self._scores: List[np.ndarray] = []
+        self._labels: List[np.ndarray] = []
+        self._weights: List[np.ndarray] = []
+
+    def update(self, scores, labels, weights=None) -> None:
+        s = np.asarray(scores, np.float64).ravel()
+        l = np.asarray(labels, np.float64).ravel()
+        if ((l < 0) | (l > 1)).any():
+            raise ValueError(
+                "RankAuc labels must lie in [0, 1] (binary or click-rate "
+                f"weights); got range [{l.min()}, {l.max()}]. For graded "
+                "relevance labels use PnPair."
+            )
+        w = (np.ones_like(s) if weights is None
+             else np.asarray(weights, np.float64).ravel())
+        self._scores.append(s)
+        self._labels.append(l)
+        self._weights.append(w)
+
+    def eval(self) -> float:
+        if not self._scores:
+            return 0.0
+        s = np.concatenate(self._scores)
+        l = np.concatenate(self._labels)
+        w = np.concatenate(self._weights)
+        order = np.argsort(s, kind="stable")
+        s, l, w = s[order], l[order], w[order]
+        # weighted Wilcoxon: rank-sum of positives with tie handling
+        pos_w = l * w
+        neg_w = (1.0 - l) * w
+        total_pos = pos_w.sum()
+        total_neg = neg_w.sum()
+        if total_pos == 0 or total_neg == 0:
+            return 0.0
+        auc = 0.0
+        neg_below = 0.0
+        i = 0
+        n = len(s)
+        while i < n:
+            j = i
+            tp = tn = 0.0
+            while j < n and s[j] == s[i]:
+                tp += pos_w[j]
+                tn += neg_w[j]
+                j += 1
+            auc += tp * (neg_below + tn / 2.0)
+            neg_below += tn
+            i = j
+        return float(auc / (total_pos * total_neg))
+
+
+class PnPair(Evaluator):
+    """Positive/negative pair ratio within queries (reference:
+
+    PnpairEvaluator, Evaluator.cpp:595): for every pair of samples in the
+    same query whose labels differ, the pair is positive if the
+    higher-labelled sample scored higher, negative if lower; ties count
+    half to each. eval() returns pos/neg."""
+
+    name = "pnpair"
+
+    def __init__(self):
+        self.reset()
+
+    def reset(self) -> None:
+        # buffer samples and pair in eval(): same-query pairs may span
+        # update() calls, and a streaming metric must be batch-size-invariant
+        self._rows: List[Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]] = []
+
+    def update(self, scores, labels, query_ids, weights=None) -> None:
+        s = np.asarray(scores, np.float64).ravel()
+        l = np.asarray(labels, np.float64).ravel()
+        q = np.asarray(query_ids).ravel()
+        w = (np.ones_like(s) if weights is None
+             else np.asarray(weights, np.float64).ravel())
+        self._rows.append((s, l, q, w))
+
+    def eval(self) -> float:
+        if not self._rows:
+            return float("inf")
+        s = np.concatenate([r[0] for r in self._rows])
+        l = np.concatenate([r[1] for r in self._rows])
+        q = np.concatenate([r[2] for r in self._rows])
+        w = np.concatenate([r[3] for r in self._rows])
+        pos = neg = 0.0
+        for qid in np.unique(q):
+            idx = np.nonzero(q == qid)[0]
+            for a in range(len(idx)):
+                for b_ in range(a + 1, len(idx)):
+                    i, j = idx[a], idx[b_]
+                    if l[i] == l[j]:
+                        continue
+                    hi, lo = (i, j) if l[i] > l[j] else (j, i)
+                    pw = (w[hi] + w[lo]) / 2.0
+                    if s[hi] > s[lo]:
+                        pos += pw
+                    elif s[hi] < s[lo]:
+                        neg += pw
+                    else:
+                        pos += pw / 2.0
+                        neg += pw / 2.0
+        return float(pos / neg) if neg else float("inf")
+
+
+class ValuePrinter(Evaluator):
+    """Debug evaluator (reference: ValuePrinter/GradPrinter registrations,
+
+    Evaluator.cpp:1006-1357): records summary stats of every array it is
+    fed and prints them at eval()."""
+
+    name = "value_printer"
+
+    def __init__(self, label: str = "value"):
+        self.label = label
+        self.reset()
+
+    def reset(self) -> None:
+        self._stats: List[str] = []
+
+    def update(self, *arrays) -> None:
+        for a in arrays:
+            a = np.asarray(a)
+            if a.size == 0:
+                self._stats.append(f"shape={a.shape} empty")
+            else:
+                self._stats.append(
+                    f"shape={a.shape} mean={a.mean():.6g} "
+                    f"absmax={np.abs(a).max():.6g}"
+                )
+
+    def eval(self) -> str:
+        out = "\n".join(f"{self.label}[{i}]: {s}" for i, s in enumerate(self._stats))
+        print(out)
+        return out

@@ -13,6 +13,8 @@ from .detection import *  # noqa: F401,F403
 from .detection import __all__ as _det_all
 from .misc import *  # noqa: F401,F403
 from .misc import __all__ as _misc_all
+from .generation import *  # noqa: F401,F403
+from .generation import __all__ as _gen_all
 from .nn import *  # noqa: F401,F403
 from .nn import __all__ as _nn_all
 from .recurrent import *  # noqa: F401,F403
@@ -22,5 +24,5 @@ from .sequence import __all__ as _seq_all
 
 __all__ = (
     list(_nn_all) + list(_seq_all) + list(_att_all) + list(_crf_all)
-    + list(_ctc_all) + list(_misc_all) + list(_det_all) + list(_rec_all)
+    + list(_ctc_all) + list(_misc_all) + list(_det_all) + list(_rec_all) + list(_gen_all)
 )

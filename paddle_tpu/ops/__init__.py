@@ -13,6 +13,7 @@ from . import crf_ops  # noqa: F401
 from . import misc_ops  # noqa: F401
 from . import ctc_ops  # noqa: F401
 from . import detection_ops  # noqa: F401
+from . import generation_ops  # noqa: F401
 from . import math_ops  # noqa: F401
 from . import nn_ops  # noqa: F401
 from . import optimizer_ops  # noqa: F401

@@ -29,6 +29,7 @@ import jax.numpy as jnp
 
 from ..core.lod import LoDArray
 from ..core.registry import register_op
+from . import beam_common
 from .rnn_ops import gru_cell
 
 
@@ -130,13 +131,10 @@ def attention_gru_beam_search_kernel(ctx):
     ).astype(enc_b.dtype)
     B = enc_b.shape[0]
     V = emb.shape[0]
-    neg_inf = jnp.asarray(-1e9, enc_b.dtype)
 
     h_beams = jnp.broadcast_to(h0[:, None], (B, K, h0.shape[-1]))
     tokens = jnp.full((B, K), bos, jnp.int32)
-    # only beam 0 is live at t=0 so the first expansion isn't K duplicates
-    scores = jnp.where(jnp.arange(K) == 0, 0.0, neg_inf) * jnp.ones((B, 1))
-    scores = scores.astype(enc_b.dtype)
+    scores = beam_common.init_scores(B, K, enc_b.dtype)
     finished = jnp.zeros((B, K), bool)
 
     def step(carry, _):
@@ -153,14 +151,8 @@ def attention_gru_beam_search_kernel(ctx):
             h_new, w_out, preferred_element_type=jnp.float32
         ).astype(h.dtype) + b_out  # [B, K, V]
         logp = jax.nn.log_softmax(logits, axis=-1)
-        # finished beams may only emit EOS at zero cost (hypothesis frozen)
-        eos_onehot = (jnp.arange(V) == eos).astype(logp.dtype)
-        logp = jnp.where(fin[..., None], jnp.log(eos_onehot + 1e-30), logp)
-        total = sc[..., None] + logp  # [B, K, V]
-        flat = total.reshape(B, K * V)
-        top_sc, top_idx = jax.lax.top_k(flat, K)  # [B, K]
-        parent = top_idx // V
-        new_tok = (top_idx % V).astype(jnp.int32)
+        logp = beam_common.freeze_finished(logp, fin, eos)
+        top_sc, parent, new_tok = beam_common.expand_prune(sc, logp, K)
         h_sel = jnp.take_along_axis(h_new, parent[..., None], axis=1)
         fin_sel = jnp.take_along_axis(fin, parent, axis=1)
         new_fin = fin_sel | (new_tok == eos)
@@ -169,30 +161,10 @@ def attention_gru_beam_search_kernel(ctx):
     (_, _, final_scores, _), (parents, toks) = jax.lax.scan(
         step, (h_beams, tokens, scores, finished), None, length=T
     )
-    # backtrack the (parent, token) trellis from the last step
-    def back(beam_idx, pt):
-        parent, tok = pt  # [B, K]
-        t = jnp.take_along_axis(tok, beam_idx, axis=1)
-        prev = jnp.take_along_axis(parent, beam_idx, axis=1)
-        return prev, t
-
-    last = jnp.broadcast_to(jnp.arange(K)[None], (B, K))
-    _, ids_rev = jax.lax.scan(back, last, (parents, toks), reverse=True)
-    ids = jnp.moveaxis(ids_rev, 0, -1)  # [B, K, T]
-
-    # lengths: first EOS position + 1 (or T if none)
-    is_eos = ids == eos
-    any_eos = is_eos.any(axis=-1)
-    first_eos = jnp.argmax(is_eos, axis=-1)
-    lengths = jnp.where(any_eos, first_eos + 1, T).astype(jnp.int32)
-    out_scores = final_scores
-    if norm_by_len:
-        out_scores = out_scores / jnp.maximum(lengths, 1).astype(out_scores.dtype)
-        # normalization can reorder hypotheses — re-sort best-first
-        order = jnp.argsort(-out_scores, axis=1)  # [B, K]
-        out_scores = jnp.take_along_axis(out_scores, order, axis=1)
-        ids = jnp.take_along_axis(ids, order[..., None], axis=1)
-        lengths = jnp.take_along_axis(lengths, order, axis=1)
+    ids = beam_common.backtrack(parents, toks, B, K)
+    ids, out_scores, lengths = beam_common.finalize(
+        ids, final_scores, eos, T, norm_by_len
+    )
 
     ctx.set_output("Ids", ids)
     ctx.set_output("Scores", out_scores)

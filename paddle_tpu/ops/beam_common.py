@@ -1,0 +1,78 @@
+"""Shared beam-search machinery.
+
+Reference: the expand/prune/backtrack cycle of
+RecurrentGradientMachine::beamSearch (RecurrentGradientMachine.h:309) and
+beam_search_op.cc/beam_search_decode_op.cc. Used by both the fixed
+attention-GRU decoder (attention_ops.py) and the generic sub-block decoder
+(generation_ops.py) so the semantics can't diverge.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e9
+
+
+def init_scores(B: int, K: int, dtype=jnp.float32):
+    """[B, K] scores with only beam 0 live at t=0, so the first expansion
+
+    isn't K duplicates of the same hypothesis."""
+    return (
+        jnp.where(jnp.arange(K) == 0, 0.0, NEG_INF) * jnp.ones((B, 1))
+    ).astype(dtype)
+
+
+def freeze_finished(logp, finished, eos: int):
+    """Finished hypotheses may only emit EOS, at zero cost; every other
+
+    continuation is -inf so no child of a frozen beam can re-enter the
+    top-k ahead of a live hypothesis."""
+    V = logp.shape[-1]
+    eos_only = jnp.where(
+        jnp.arange(V) == eos, 0.0, jnp.asarray(NEG_INF, logp.dtype)
+    )
+    return jnp.where(finished[..., None], eos_only, logp)
+
+
+def expand_prune(scores, logp, K: int):
+    """Add per-token log-probs, take the global top-K over [K*V].
+
+    Returns (new_scores [B,K], parent [B,K], token [B,K] int32)."""
+    B = scores.shape[0]
+    V = logp.shape[-1]
+    total = scores[..., None] + logp
+    top_sc, top_idx = jax.lax.top_k(total.reshape(B, K * V), K)
+    return top_sc, top_idx // V, (top_idx % V).astype(jnp.int32)
+
+
+def backtrack(parents, toks, B: int, K: int):
+    """Walk the (parent, token) trellis backwards → ids [B, K, T]."""
+
+    def back(beam_idx, pt):
+        parent, tok = pt
+        t = jnp.take_along_axis(tok, beam_idx, axis=1)
+        prev = jnp.take_along_axis(parent, beam_idx, axis=1)
+        return prev, t
+
+    last = jnp.broadcast_to(jnp.arange(K)[None], (B, K))
+    _, ids_rev = jax.lax.scan(back, last, (parents, toks), reverse=True)
+    return jnp.moveaxis(ids_rev, 0, -1)
+
+
+def finalize(ids, scores, eos: int, T: int, length_normalize: bool):
+    """Lengths to first EOS (inclusive), optional length-normalized
+
+    re-sort best-first. Returns (ids, scores, lengths)."""
+    is_eos = ids == eos
+    any_eos = is_eos.any(axis=-1)
+    first_eos = jnp.argmax(is_eos, axis=-1)
+    lengths = jnp.where(any_eos, first_eos + 1, T).astype(jnp.int32)
+    if length_normalize:
+        scores = scores / jnp.maximum(lengths, 1).astype(scores.dtype)
+        order = jnp.argsort(-scores, axis=1)
+        scores = jnp.take_along_axis(scores, order, axis=1)
+        ids = jnp.take_along_axis(ids, order[..., None], axis=1)
+        lengths = jnp.take_along_axis(lengths, order, axis=1)
+    return ids, scores, lengths

@@ -1,0 +1,116 @@
+"""beam_search_group op kernel: generic jitted beam-search generation.
+
+Reference: RecurrentGradientMachine::beamSearch
+(RecurrentGradientMachine.h:309) — per-step: run the frame net on every
+live hypothesis, expand by the vocabulary, prune to the beam width
+(hl_top_k.cu), freeze finished hypotheses; then decode by backtracking.
+Fluid equivalents: beam_search_op.cc / beam_search_decode_op.cc.
+
+The step network is a traced program sub-block (the generic analogue of
+the frame net), run on the flattened [B*K, ...] beam batch each scan step.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.lod import LoDArray
+from ..core.registry import register_op
+from . import beam_common
+
+
+def _tile_beam(x, K):
+    """[B, ...] -> [B*K, ...] (repeat each example K times)."""
+    return jnp.repeat(x, K, axis=0)
+
+
+@register_op("beam_search_group")
+def beam_search_group_kernel(ctx):
+    boots = ctx.inputs("Boot")
+    per_example_vals = ctx.inputs("PerExample")
+    K = ctx.attr("beam_size", 4)
+    T = ctx.attr("max_len", 32)
+    bos = ctx.attr("bos_id", 0)
+    eos = ctx.attr("eos_id", 1)
+    norm_by_len = ctx.attr("length_normalize", False)
+    prev_inner = ctx.attr("prev_inner")
+    mem_inner = list(ctx.attr("mem_inner"))
+    mem_update = list(ctx.attr("mem_update"))
+    per_example = list(ctx.attr("per_example"))
+    logits_inner = ctx.attr("logits_inner")
+
+    if not boots:
+        raise ValueError("beam_search_group needs at least one booted memory")
+    b0 = boots[0]
+    b0 = b0.data if isinstance(b0, LoDArray) else b0
+    B = b0.shape[0]
+
+    block = ctx.executor.program.blocks[ctx.attr("sub_block")]
+    outer_env = dict(ctx.env)
+    # per-decode RNG stream (same per-frame freshness recurrent_ops gives):
+    # consume one outer counter, fold the step index in inside the scan
+    base_key = jax.random.fold_in(
+        outer_env["@RNG@"], outer_env.get("@RNG_COUNTER@", 0)
+    )
+    ctx.env["@RNG_COUNTER@"] = outer_env.get("@RNG_COUNTER@", 0) + 1
+    # shadow per-example closure tensors with their beam-tiled versions
+    for name, v in zip(per_example, per_example_vals):
+        v = v.data if isinstance(v, LoDArray) else v
+        outer_env[name] = _tile_beam(v, K)
+
+    mems0 = []
+    for bv in boots:
+        bv = bv.data if isinstance(bv, LoDArray) else bv
+        mems0.append(jnp.broadcast_to(bv[:, None], (B, K) + bv.shape[1:]))
+
+    tokens = jnp.full((B, K), bos, jnp.int32)
+    scores = beam_common.init_scores(B, K)
+    finished = jnp.zeros((B, K), bool)
+
+    def step(carry, t):
+        mems, tok, sc, fin = carry
+        env = dict(outer_env)
+        env["@RNG@"] = jax.random.fold_in(base_key, t)
+        env["@RNG_COUNTER@"] = 0
+        env[prev_inner] = tok.reshape(B * K)
+        for name, m in zip(mem_inner, mems):
+            env[name] = m.reshape((B * K,) + m.shape[2:])
+        ctx.executor.run_ops(block.ops, env, dict(env), block)
+        logits = env[logits_inner]
+        V = logits.shape[-1]
+        logits = logits.reshape(B, K, V).astype(jnp.float32)
+        new_mems = tuple(
+            jnp.where(
+                fin.reshape(B, K, *([1] * (m.ndim - 2))),
+                m,
+                env[u].reshape(m.shape),
+            )
+            for u, m in zip(mem_update, mems)
+        )
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        logp = beam_common.freeze_finished(logp, fin, eos)
+        top_sc, parent, new_tok = beam_common.expand_prune(sc, logp, K)
+        sel_mems = tuple(
+            jnp.take_along_axis(
+                m, parent.reshape(B, K, *([1] * (m.ndim - 2))), axis=1
+            )
+            for m in new_mems
+        )
+        fin_sel = jnp.take_along_axis(fin, parent, axis=1)
+        new_fin = fin_sel | (new_tok == eos)
+        return (sel_mems, new_tok, top_sc, new_fin), (parent, new_tok)
+
+    (_, _, final_scores, _), (parents, toks) = jax.lax.scan(
+        step, (tuple(mems0), tokens, scores, finished),
+        jnp.arange(T, dtype=jnp.int32),
+    )
+
+    ids = beam_common.backtrack(parents, toks, B, K)
+    ids, out_scores, lengths = beam_common.finalize(
+        ids, final_scores, eos, T, norm_by_len
+    )
+
+    ctx.set_output("Ids", ids)
+    ctx.set_output("Scores", out_scores)
+    ctx.set_output("Lengths", lengths)

@@ -131,3 +131,69 @@ def test_detection_map_ranked():
         np.array([0]),
     )
     assert ev11.eval() == pytest.approx(0.5)
+
+
+def test_rank_auc_against_sklearn_style_oracle():
+    from paddle_tpu.evaluator import RankAuc
+
+    rng = np.random.RandomState(0)
+    scores = rng.randn(200)
+    labels = (rng.rand(200) > 0.5).astype(np.float64)
+    ev = RankAuc()
+    ev.update(scores[:100], labels[:100])
+    ev.update(scores[100:], labels[100:])
+    got = ev.eval()
+    # plain O(n^2) oracle
+    pos = scores[labels == 1]
+    neg = scores[labels == 0]
+    cmp = (pos[:, None] > neg[None, :]).sum() + 0.5 * (
+        pos[:, None] == neg[None, :]
+    ).sum()
+    want = cmp / (len(pos) * len(neg))
+    assert abs(got - want) < 1e-9
+
+
+def test_pnpair():
+    from paddle_tpu.evaluator import PnPair
+
+    ev = PnPair()
+    # query 0: labels 2>1, score order correct; query 1: order inverted
+    ev.update(scores=[0.9, 0.1, 0.2, 0.8], labels=[2, 1, 3, 0],
+              query_ids=[0, 0, 1, 1])
+    # q0: pair (2,1) correct -> pos; q1: pair (3,0) wrong -> neg
+    assert ev.eval() == 1.0
+
+
+def test_value_printer(capsys):
+    from paddle_tpu.evaluator import ValuePrinter
+
+    ev = ValuePrinter("act")
+    ev.update(np.ones((2, 3)), np.zeros(5))
+    out = ev.eval()
+    assert "act[0]" in out and "mean=1" in out
+
+
+def test_pnpair_cross_batch_pairs():
+    """Same-query pairs spanning update() calls must still be paired."""
+    from paddle_tpu.evaluator import PnPair
+
+    ev = PnPair()
+    ev.update(scores=[0.9], labels=[2], query_ids=[7])
+    ev.update(scores=[0.1], labels=[1], query_ids=[7])
+    assert ev.eval() == float("inf")  # one positive pair, zero negatives
+
+
+def test_rank_auc_rejects_graded_labels():
+    from paddle_tpu.evaluator import RankAuc
+
+    ev = RankAuc()
+    with pytest.raises(ValueError, match="labels must lie"):
+        ev.update([0.5, 0.2], [2, 1])
+
+
+def test_value_printer_empty_array():
+    from paddle_tpu.evaluator import ValuePrinter
+
+    ev = ValuePrinter("x")
+    ev.update(np.zeros((0, 4)))
+    assert "empty" in ev.eval()
