@@ -4,6 +4,7 @@ Reference: python/paddle/v2/reader + dataset + data_feeder (SURVEY.md §2.2).
 """
 
 from . import reader  # noqa: F401
+from .feeder import DataFeeder, DevicePrefetcher  # noqa: F401
 from .reader import batch, buffered, cache, chain, compose, firstn, map_readers, shuffle, xmap_readers  # noqa: F401
 
 # recordio/master build the native .so lazily at first use; the import

@@ -49,3 +49,71 @@ class DataFeeder:
                     max_seqs=self.max_seqs or len(batch),
                 )
         return out
+
+
+class DevicePrefetcher:
+    """Async double-buffered host→device pipeline.
+
+    Reference: DataProvider's double-buffered async loading
+    (gserver/dataproviders/DataProvider.h:292,328 — background thread at
+    :375 fills a queue while the trainer consumes). TPU version: a daemon
+    thread walks the reader, converts batches (optionally through a
+    DataFeeder) and jax.device_put's them `depth` batches ahead, so the
+    h2d transfer of batch N+1 overlaps the device compute of batch N —
+    the single biggest win when the host link is slow.
+
+    Usage::
+
+        for feed in DevicePrefetcher(reader, feeder, depth=2):
+            exe.run(prog, feed=feed, ...)
+    """
+
+    def __init__(self, reader, feeder=None, depth: int = 2, device=None):
+        self.reader = reader
+        self.feeder = feeder
+        self.depth = max(1, int(depth))
+        self.device = device
+
+    def __iter__(self):
+        import queue as _queue
+        import threading
+
+        import jax
+
+        q: "_queue.Queue" = _queue.Queue(maxsize=self.depth)
+        stop = threading.Event()
+        END, ERR = object(), object()
+
+        def produce():
+            try:
+                for batch in self.reader():
+                    if stop.is_set():
+                        return
+                    feed = self.feeder.feed(batch) if self.feeder else batch
+                    feed = {
+                        k: jax.device_put(v, self.device)
+                        for k, v in feed.items()
+                    }
+                    q.put(feed)
+                q.put(END)
+            except BaseException as e:  # surface reader errors to consumer
+                q.put((ERR, e))
+
+        t = threading.Thread(target=produce, daemon=True)
+        t.start()
+        try:
+            while True:
+                item = q.get()
+                if item is END:
+                    return
+                if isinstance(item, tuple) and len(item) == 2 and item[0] is ERR:
+                    raise item[1]
+                yield item
+        finally:
+            stop.set()
+            # drain so a blocked producer can observe stop and exit
+            while not q.empty():
+                try:
+                    q.get_nowait()
+                except _queue.Empty:
+                    break

@@ -408,12 +408,12 @@ class DetectionMAP(Evaluator):
 
 
 class RankAuc(Evaluator):
-    """Pairwise ranking AUC over (score, label[, weight]) samples grouped
+    """Global pairwise ranking AUC over (score, label[, weight]) samples
 
-    by query (reference: RankAucEvaluator, Evaluator.cpp:514 — computes
-    AUC from the label-weighted rank order of scores). Without query ids
-    it reduces to the classic Wilcoxon/AUC statistic like `Auc`, but fed
-    with continuous click/label weights rather than binary labels."""
+    (reference: RankAucEvaluator, Evaluator.cpp:514 — the label-weighted
+    Wilcoxon rank statistic). Labels are [0,1] click rates, optionally
+    weighted. This is global (not query-grouped); for per-query pairwise
+    quality use `PnPair`, which takes query_ids."""
 
     name = "rank_auc"
 
@@ -448,28 +448,20 @@ class RankAuc(Evaluator):
         w = np.concatenate(self._weights)
         order = np.argsort(s, kind="stable")
         s, l, w = s[order], l[order], w[order]
-        # weighted Wilcoxon: rank-sum of positives with tie handling
+        # weighted Wilcoxon: rank-sum of positives, ties counted half —
+        # vectorized by tie group (np.unique on the sorted scores)
         pos_w = l * w
         neg_w = (1.0 - l) * w
         total_pos = pos_w.sum()
         total_neg = neg_w.sum()
         if total_pos == 0 or total_neg == 0:
             return 0.0
-        auc = 0.0
-        neg_below = 0.0
-        i = 0
-        n = len(s)
-        while i < n:
-            j = i
-            tp = tn = 0.0
-            while j < n and s[j] == s[i]:
-                tp += pos_w[j]
-                tn += neg_w[j]
-                j += 1
-            auc += tp * (neg_below + tn / 2.0)
-            neg_below += tn
-            i = j
-        return float(auc / (total_pos * total_neg))
+        _, inv = np.unique(s, return_inverse=True)
+        tp = np.bincount(inv, weights=pos_w)  # per tie-group positive mass
+        tn = np.bincount(inv, weights=neg_w)
+        neg_below = np.concatenate([[0.0], np.cumsum(tn)[:-1]])
+        auc = float(np.sum(tp * (neg_below + tn / 2.0)))
+        return auc / (total_pos * total_neg)
 
 
 class PnPair(Evaluator):
@@ -508,20 +500,18 @@ class PnPair(Evaluator):
         pos = neg = 0.0
         for qid in np.unique(q):
             idx = np.nonzero(q == qid)[0]
-            for a in range(len(idx)):
-                for b_ in range(a + 1, len(idx)):
-                    i, j = idx[a], idx[b_]
-                    if l[i] == l[j]:
-                        continue
-                    hi, lo = (i, j) if l[i] > l[j] else (j, i)
-                    pw = (w[hi] + w[lo]) / 2.0
-                    if s[hi] > s[lo]:
-                        pos += pw
-                    elif s[hi] < s[lo]:
-                        neg += pw
-                    else:
-                        pos += pw / 2.0
-                        neg += pw / 2.0
+            ls, ss, ws = l[idx], s[idx], w[idx]
+            # vectorized over the query's pair matrix; keep each unordered
+            # pair once with the higher-labelled sample as row
+            hi = ls[:, None] > ls[None, :]
+            pw = (ws[:, None] + ws[None, :]) / 2.0
+            s_hi = ss[:, None]
+            s_lo = ss[None, :]
+            pos += float((pw * (hi & (s_hi > s_lo))).sum())
+            neg += float((pw * (hi & (s_hi < s_lo))).sum())
+            half = float((pw * (hi & (s_hi == s_lo))).sum()) / 2.0
+            pos += half
+            neg += half
         return float(pos / neg) if neg else float("inf")
 
 

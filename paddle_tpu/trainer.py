@@ -159,8 +159,13 @@ class Trainer:
         event_handler: Optional[Callable] = None,
         fetch_metrics: Optional[Dict[str, Variable]] = None,
         test_reader: Optional[Callable] = None,
+        prefetch_to_device: int = 0,
     ) -> Dict[str, float]:
-        """Pass/batch loop. Returns the final EndPass metrics dict."""
+        """Pass/batch loop. Returns the final EndPass metrics dict.
+
+        prefetch_to_device > 0 enables the async double-buffered
+        host→device pipeline (DataProvider.h:375 parity) with that queue
+        depth — batch N+1's transfer overlaps batch N's compute."""
         if not self._initialized:
             self.init()
         self._stop = False
@@ -177,7 +182,15 @@ class Trainer:
             self._resume_batch = 0  # only the resumed pass skips
             last_batch_id = -1
             interrupted_mid_pass = False
-            for batch_id, data in enumerate(reader()):
+            if prefetch_to_device:
+                from .data.feeder import DevicePrefetcher
+
+                batches = iter(
+                    DevicePrefetcher(reader, feeder, depth=prefetch_to_device)
+                )
+            else:
+                batches = reader()
+            for batch_id, data in enumerate(batches):
                 if self._stop:
                     interrupted_mid_pass = True
                     break
@@ -186,7 +199,10 @@ class Trainer:
                     continue
                 handler(BeginIteration(pass_id, batch_id))
                 with profiler.timer("prepareBatchData"):
-                    feed = feeder.feed(data) if feeder else data
+                    if prefetch_to_device:
+                        feed = data  # already converted + on device
+                    else:
+                        feed = feeder.feed(data) if feeder else data
                 sp = FLAGS.show_param_stats_period
                 want_stats = bool(sp) and (self.step + 1) % sp == 0
                 step_fetch = list(fetch_list)
