@@ -46,6 +46,7 @@ __all__ = [
     "cast",
     "fill_constant",
     "increment",
+    "clip",
     "topk",
     "argmax",
     "lrn",
@@ -537,10 +538,26 @@ def matmul(x, y, transpose_x=False, transpose_y=False):
     return _binary("matmul", x, y, {"transpose_X": transpose_x, "transpose_Y": transpose_y})
 
 
+def clip(x, min, max):  # noqa: A002 — fluid layers.clip signature
+    """Reference: fluid layers clip / operators/clip_op.cc."""
+    return _unary("clip", x, {"min": float(min), "max": float(max)})
+
+
+def _reduced_shape(shape, dim, keep_dim):
+    if dim is None:
+        return (1,) if keep_dim else ()
+    dims = (dim,) if isinstance(dim, int) else tuple(dim)
+    dims = tuple(d % len(shape) for d in dims)
+    if keep_dim:
+        return tuple(1 if i in dims else s for i, s in enumerate(shape))
+    return tuple(s for i, s in enumerate(shape) if i not in dims)
+
+
 def reduce_sum(x, dim=None, keep_dim=False):
     return _unary(
         "reduce_sum", x,
         {"dim": dim, "keep_dim": keep_dim, "reduce_all": dim is None},
+        out_shape=_reduced_shape(x.shape, dim, keep_dim),
     )
 
 
@@ -548,6 +565,7 @@ def reduce_mean(x, dim=None, keep_dim=False):
     return _unary(
         "reduce_mean", x,
         {"dim": dim, "keep_dim": keep_dim, "reduce_all": dim is None},
+        out_shape=_reduced_shape(x.shape, dim, keep_dim),
     )
 
 

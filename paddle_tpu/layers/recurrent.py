@@ -27,7 +27,7 @@ import numpy as np
 from ..core.program import Variable, unique_name
 from .helper import LayerHelper
 
-__all__ = ["RecurrentGroup", "StaticRNN", "recurrent_group"]
+__all__ = ["RecurrentGroup", "StaticRNN", "recurrent_group", "NestedRecurrentGroup"]
 
 
 class _Memory:
@@ -246,3 +246,114 @@ def recurrent_group(step_fn, inputs, is_reverse: bool = False, max_len=None):
         for o in outs:
             rnn.step_output(o)
     return rnn()
+
+
+class NestedRecurrentGroup(RecurrentGroup):
+    """Outer recurrence over SUB-sequences of a 2-level ragged input.
+
+    Reference: `recurrent_group(step, input=SubsequenceInput(x))`
+    (trainer_config_helpers/layers.py:69-88) executed by
+    RecurrentGradientMachine::createInFrameInfo_subseq
+    (RecurrentGradientMachine.h:374-383) — each outer frame receives one
+    whole subsequence (e.g. a sentence of a paragraph); the outer output
+    has one step per subsequence. The canonical use is a hierarchical RNN:
+    an inner word-level reduction inside an outer sentence-level
+    recurrence.
+
+    TPU design: the t-th subsequence of every outer sequence is densified
+    to [B, max_sublen, D] + mask and scanned over max_subseqs steps; the
+    step body is a program sub-block; outputs reassemble into a 1-level
+    LoD sequence with one token per subsequence. Sequences with more than
+    max_subseqs subsequences are truncated (RecurrentGroup.max_len
+    semantics); sub-sequences longer than max_sublen are truncated too.
+
+    CAUTION: padded outer steps run the step body on all-zero inputs
+    (their results are masked out of memories/outputs, but gradients flow
+    through jnp.where) — guard divisions/logs against the empty case,
+    e.g. clip a token count to >= 1 before dividing.
+
+    Usage::
+
+        rnn = pt.layers.NestedRecurrentGroup(max_subseqs=4, max_sublen=8)
+        with rnn.step():
+            sub, sub_mask = rnn.step_input(x2)   # [B, L, D], [B, L]
+            h_prev = rnn.memory(shape=[H])
+            pooled = ...reduce sub over L with sub_mask...
+            h = ...combine pooled with h_prev...
+            rnn.update_memory(h_prev, h)
+            rnn.step_output(h)
+        out = rnn()   # lod_level=1: one token per subsequence
+
+    Build-phase machinery (memory/update_memory/step_output/step/call) is
+    inherited from RecurrentGroup; only the step-input contract (a whole
+    densified subsequence instead of one token row) and the emitted op
+    differ."""
+
+    def __init__(self, max_subseqs: int, max_sublen: int, name=None):
+        super().__init__(name=name)
+        self.helper = LayerHelper("nested_recurrent_group", name=name)
+        self.max_subseqs = int(max_subseqs)
+        self.max_sublen = int(max_sublen)
+        # _seq_pairs holds (outer, inner dense, inner mask) triples here
+
+    def step_input(self, seq: Variable):
+        """2-level sequence; returns (dense [B, L, ...], mask [B, L])."""
+        self._require_in_step("step_input")
+        if seq.lod_level < 2:
+            raise ValueError(
+                f"NestedRecurrentGroup needs lod_level=2 input: {seq.name}")
+        trailing = tuple(d for d in seq.shape[1:] if d != -1)
+        inner = self._block.create_var(
+            unique_name(f"{self.helper.name}.sub"),
+            (-1, self.max_sublen) + trailing, seq.dtype)
+        mask = self._block.create_var(
+            unique_name(f"{self.helper.name}.submask"),
+            (-1, self.max_sublen), np.bool_)
+        self._seq_pairs.append((seq, inner, mask))
+        return inner, mask
+
+    def _complete(self):
+        if not self._seq_pairs:
+            raise ValueError("nested_recurrent_group needs a step_input")
+        if not self._step_outputs:
+            raise ValueError("nested_recurrent_group needs a step_output")
+        for m in self._memories:
+            if m.update is None:
+                raise ValueError(f"memory {m.inner.name} never updated")
+        helper = self.helper
+        parent = helper.block
+        for v in self._step_outputs:
+            self.outputs.append(parent.create_var(
+                unique_name(f"{helper.name}.out"), tuple(v.shape), v.dtype,
+                lod_level=1))
+        for m in self._memories:
+            self.final_memories.append(parent.create_var(
+                unique_name(f"{helper.name}.final"), tuple(m.inner.shape),
+                m.inner.dtype))
+        boot_vars = [m.boot for m in self._memories if m.boot is not None]
+        parent.append_op(
+            "nested_recurrent_group",
+            inputs={
+                "Seq": [o.name for o, _, _ in self._seq_pairs],
+                "Boot": [v.name for v in boot_vars],
+            },
+            outputs={
+                "Out": [v.name for v in self.outputs],
+                "FinalMem": [v.name for v in self.final_memories],
+            },
+            attrs={
+                "sub_block": self._block.idx,
+                "seq_inner": [i.name for _, i, _ in self._seq_pairs],
+                "seq_inner_mask": [mk.name for _, _, mk in self._seq_pairs],
+                "mem_inner": [m.inner.name for m in self._memories],
+                "mem_update": [m.update.name for m in self._memories],
+                "mem_has_boot": [m.boot is not None for m in self._memories],
+                "mem_shape": [list(m.shape) for m in self._memories],
+                "mem_init_value": [m.init_value for m in self._memories],
+                "mem_dtype": [np.dtype(m.inner.dtype).name
+                              for m in self._memories],
+                "out_inner": [v.name for v in self._step_outputs],
+                "max_subseqs": self.max_subseqs,
+                "max_sublen": self.max_sublen,
+            },
+        )

@@ -115,3 +115,151 @@ def recurrent_group_kernel(ctx):
     for i, f in enumerate(final):
         if i < len(ctx.op.outputs.get("FinalMem", [])):
             ctx.set_output("FinalMem", f, i)
+
+
+def _lod_from_lengths(lengths, capacity: int, like_data, trailing_shape,
+                      num_seqs):
+    """Build an empty LoDArray with the given per-sequence lengths."""
+    offsets = jnp.concatenate(
+        [jnp.zeros((1,), jnp.int32), jnp.cumsum(lengths, dtype=jnp.int32)]
+    )
+    total = offsets[-1]
+    pos = jnp.arange(capacity, dtype=jnp.int32)
+    seq_ids = jnp.searchsorted(offsets, pos, side="right").astype(jnp.int32) - 1
+    seq_ids = jnp.where(pos < total, seq_ids, -1)
+    data = jnp.zeros((capacity,) + tuple(trailing_shape), like_data.dtype)
+    return LoDArray(data, seq_ids, lengths.astype(jnp.int32), num_seqs)
+
+
+@register_op("nested_recurrent_group")
+def nested_recurrent_group_kernel(ctx):
+    """Outer recurrence over sub-sequences of 2-level ragged inputs.
+
+    Reference: RecurrentGradientMachine::createInFrameInfo_subseq
+    (RecurrentGradientMachine.h:374-383) — frame t of outer sequence b is
+    that sequence's t-th SUB-sequence. Densified to [S, B, L, ...] + masks
+    with segment ops over (seq_ids, sub_seq_ids), then scanned like
+    recurrent_group; outputs form a 1-level sequence with one token per
+    sub-sequence."""
+    seqs = ctx.inputs("Seq")
+    boots = ctx.inputs("Boot")
+    first: LoDArray = seqs[0]
+    if first.sub_seq_ids is None:
+        raise ValueError("nested_recurrent_group needs a 2-level LoDArray "
+                         "(built via LoDArray.from_nested_sequences)")
+    S = ctx.attr("max_subseqs")
+    L = ctx.attr("max_sublen")
+    B = first.max_seqs
+    C = first.capacity
+    # the global subsequence-id space must cover every sub in the batch
+    # regardless of how they distribute across sequences; each sub has at
+    # least one token, so the flat capacity bounds it
+    G = C
+
+    sub_ids = first.sub_seq_ids
+    seq_ids = first.seq_ids
+    valid_tok = sub_ids >= 0
+    sub_clip = jnp.where(valid_tok, sub_ids, 0)
+
+    sub_len = jnp.zeros((G,), jnp.int32).at[sub_clip].add(
+        valid_tok.astype(jnp.int32))
+    big = jnp.asarray(C, jnp.int32)
+    tok_pos = jnp.arange(C, dtype=jnp.int32)
+    sub_start = jax.ops.segment_min(
+        jnp.where(valid_tok, tok_pos, big), sub_clip, num_segments=G)
+    seq_of_sub = jax.ops.segment_max(
+        jnp.where(valid_tok, seq_ids, -1), sub_clip, num_segments=G)
+    sub_valid = sub_len > 0
+    num_subs = jnp.zeros((B,), jnp.int32).at[
+        jnp.where(sub_valid, seq_of_sub, 0)
+    ].add(sub_valid.astype(jnp.int32))
+    first_sub = jax.ops.segment_min(
+        jnp.where(sub_valid, jnp.arange(G, dtype=jnp.int32), G),
+        jnp.where(sub_valid, seq_of_sub, 0), num_segments=B)
+    first_sub = jnp.where(num_subs > 0, first_sub, 0)
+
+    # gather map: (s, b, l) -> flat token index
+    b_idx = jnp.arange(B, dtype=jnp.int32)[None, :, None]     # [1,B,1]
+    s_idx = jnp.arange(S, dtype=jnp.int32)[:, None, None]     # [S,1,1]
+    l_idx = jnp.arange(L, dtype=jnp.int32)[None, None, :]     # [1,1,L]
+    g = jnp.clip(first_sub[b_idx] + s_idx, 0, G - 1)          # [S,B,1]
+    flat = jnp.clip(sub_start[g] + l_idx, 0, C - 1)           # [S,B,L]
+    tok_mask = (s_idx < num_subs[b_idx]) & (l_idx < sub_len[g])
+
+    step_mask = s_idx[:, :, 0] < num_subs[b_idx[:, :, 0]]     # [S,B]
+
+    mem_inner = list(ctx.attr("mem_inner"))
+    mem_update = list(ctx.attr("mem_update"))
+    mem_has_boot = list(ctx.attr("mem_has_boot"))
+    mem_shape = [tuple(s_) for s_ in ctx.attr("mem_shape")]
+    mem_init = list(ctx.attr("mem_init_value"))
+    mem_dtype = list(ctx.attr("mem_dtype"))
+    seq_inner = list(ctx.attr("seq_inner"))
+    seq_inner_mask = list(ctx.attr("seq_inner_mask"))
+    out_inner = list(ctx.attr("out_inner"))
+
+    subs = []
+    for sq in seqs:
+        if sq.capacity != C or sq.max_seqs != B:
+            raise ValueError("nested step inputs must share one LoD layout")
+        d = sq.data[flat]  # [S, B, L, ...]
+        d = jnp.where(
+            tok_mask.reshape(tok_mask.shape + (1,) * (sq.data.ndim - 1)), d, 0)
+        subs.append(d)
+
+    carries = []
+    boot_it = iter(boots)
+    for has_boot, shape, init, dt in zip(
+        mem_has_boot, mem_shape, mem_init, mem_dtype
+    ):
+        if has_boot:
+            bv = next(boot_it)
+            bv = bv.data if isinstance(bv, LoDArray) else bv
+            if bv.shape[0] != B:
+                raise ValueError(
+                    f"memory boot batch {bv.shape[0]} != sequence batch {B}"
+                )
+            carries.append(bv)
+        else:
+            carries.append(jnp.full((B,) + shape, init, jnp.dtype(dt)))
+
+    block = ctx.executor.program.blocks[ctx.attr("sub_block")]
+    outer_env = dict(ctx.env)
+    base_key = jax.random.fold_in(
+        outer_env["@RNG@"], outer_env.get("@RNG_COUNTER@", 0))
+    ctx.env["@RNG_COUNTER@"] = outer_env.get("@RNG_COUNTER@", 0) + 1
+
+    def body(carry, step):
+        step_subs, step_tok_mask, m, t = step
+        env = dict(outer_env)
+        env["@RNG@"] = jax.random.fold_in(base_key, t)
+        env["@RNG_COUNTER@"] = 0
+        for name, v in zip(seq_inner, step_subs):
+            env[name] = v
+        for name in seq_inner_mask:
+            env[name] = step_tok_mask
+        for name, c_ in zip(mem_inner, carry):
+            env[name] = c_
+        ctx.executor.run_ops(block.ops, env, dict(env), block)
+        new_carry = tuple(
+            jnp.where(m.reshape((B,) + (1,) * (env[u].ndim - 1)), env[u], c_)
+            for u, c_ in zip(mem_update, carry))
+        outs = tuple(env[o] for o in out_inner)
+        return new_carry, outs
+
+    final, outs = jax.lax.scan(
+        body, tuple(carries),
+        (tuple(subs), tok_mask, step_mask, jnp.arange(S, dtype=jnp.int32)),
+    )
+
+    # sequences with more than S subsequences are TRUNCATED (same semantics
+    # as RecurrentGroup.max_len): the output claims only the steps that ran
+    out_lens = jnp.minimum(num_subs, S)
+    for i, o in enumerate(outs):
+        like = _lod_from_lengths(
+            out_lens, B * S, o, o.shape[2:], first.num_seqs
+        )
+        ctx.set_output("Out", LoDArray.from_batch(o, step_mask, like), i)
+    for i, f in enumerate(final):
+        if i < len(ctx.op.outputs.get("FinalMem", [])):
+            ctx.set_output("FinalMem", f, i)
