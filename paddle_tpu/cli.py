@@ -1,0 +1,145 @@
+"""Command-line driver: `python -m paddle_tpu <command> ...`.
+
+Reference: the `paddle` shell wrapper (paddle/scripts/submit_local.sh.in:6-7,
+177-180 — `paddle train / merge_model / pserver2 ...`) and the trainer
+binary's flag-driven main (paddle/trainer/TrainerMain.cpp:32). The
+"config is a program" philosophy carries over: the --config argument is a
+Python file that builds the model on the default programs and exposes
+
+    def get_model() -> dict:
+        return {
+            "cost": <loss Variable>,
+            "reader": <callable yielding batches>,
+            "feed_order": [<data Variables>],        # optional if reader
+                                                     # yields feed dicts
+            "metrics": {"name": Variable, ...},      # optional
+            "num_passes": int,                        # optional default 1
+        }
+
+Commands:
+  train       --config M.py [--num_passes N] [--save_dir D] [flags...]
+  merge_model --model_dir D --out O   (MergeModel.cpp parity: checkpoint
+                                       params -> single deployable dir)
+  flags       print the flag registry
+  version     print the version
+"""
+
+from __future__ import annotations
+
+import os
+import runpy
+import sys
+
+from .flags import FLAGS, flags_help, parse_flags
+
+
+def _load_config(path: str) -> dict:
+    ns = runpy.run_path(path)
+    if "get_model" not in ns:
+        raise SystemExit(f"config {path!r} must define get_model()")
+    model = ns["get_model"]()
+    if "cost" not in model or "reader" not in model:
+        raise SystemExit("get_model() must return at least cost and reader")
+    return model
+
+
+def _cmd_train(argv) -> int:
+    import numpy as np
+
+    from .trainer import CheckpointConfig, Trainer
+
+    cfg = {}
+    rest = []
+    i = 0
+    while i < len(argv):
+        a = argv[i]
+        if a in ("--config", "--num_passes", "--save_dir") and i + 1 < len(argv):
+            cfg[a[2:]] = argv[i + 1]
+            i += 2
+        else:
+            rest.append(a)
+            i += 1
+    parse_flags(rest)
+    if "config" not in cfg:
+        raise SystemExit("train requires --config <model.py>")
+    model = _load_config(cfg["config"])
+    num_passes = int(cfg.get("num_passes", model.get("num_passes", 1)))
+    save_dir = cfg.get("save_dir", FLAGS.save_dir)
+    ckpt = CheckpointConfig(checkpoint_dir=save_dir) if save_dir else None
+    trainer = Trainer(cost=model["cost"], checkpoint_config=ckpt)
+
+    def log_handler(event):
+        from .trainer import EndIteration, EndPass
+
+        if isinstance(event, EndIteration):
+            if event.batch_id % FLAGS.log_period == 0:
+                ms = ", ".join(f"{k}={v:.5g}" for k, v in event.metrics.items())
+                print(f"pass {event.pass_id} batch {event.batch_id} "
+                      f"cost={event.cost:.6g}" + (f" {ms}" if ms else ""))
+        elif isinstance(event, EndPass):
+            ms = ", ".join(f"{k}={v:.5g}" for k, v in event.metrics.items())
+            print(f"Pass {event.pass_id} done: {ms}")
+
+    metrics = trainer.train(
+        model["reader"],
+        num_passes=num_passes,
+        feed_order=model.get("feed_order"),
+        fetch_metrics=model.get("metrics"),
+        event_handler=log_handler,
+    )
+    print("final:", {k: round(float(v), 6) for k, v in metrics.items()})
+    return 0
+
+
+def _cmd_merge_model(argv) -> int:
+    """Checkpoint/params dir → single deployable inference dir."""
+    args = dict(zip(argv[::2], argv[1::2]))
+    model_dir = args.get("--model_dir")
+    out = args.get("--out")
+    config = args.get("--config")
+    if not (model_dir and out and config):
+        raise SystemExit(
+            "merge_model requires --config <infer_model.py> --model_dir "
+            "<params> --out <dir>; the config must define get_inference() "
+            "returning (feed_names, fetch_vars)")
+    import paddle_tpu as pt
+
+    ns = runpy.run_path(config)
+    if "get_inference" not in ns:
+        raise SystemExit("config must define get_inference()")
+    feed_names, fetch_vars = ns["get_inference"]()
+    # accept either a plain params dir (save_params) or a trainer
+    # checkpoint dir (pick the latest serial)
+    if pt.io.get_latest_checkpoint_serial(model_dir) >= 0:
+        pt.io.load_checkpoint(model_dir)
+    else:
+        pt.io.load_params(model_dir)
+    pt.io.save_inference_model(out, feed_names, fetch_vars)
+    print(f"merged model written to {out}")
+    return 0
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if not argv or argv[0] in ("-h", "--help", "help"):
+        print(__doc__)
+        return 0
+    cmd, rest = argv[0], argv[1:]
+    if cmd == "train":
+        return _cmd_train(rest)
+    if cmd == "merge_model":
+        return _cmd_merge_model(rest)
+    if cmd == "flags":
+        print(flags_help())
+        return 0
+    if cmd == "version":
+        from .version import full_version
+
+        print(full_version)
+        return 0
+    raise SystemExit(f"unknown command {cmd!r}; try: train, merge_model, "
+                     "flags, version")
+
+
+if __name__ == "__main__":
+    sys.exit(main())

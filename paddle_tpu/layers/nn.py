@@ -545,7 +545,8 @@ def clip(x, min, max):  # noqa: A002 — fluid layers.clip signature
 
 def _reduced_shape(shape, dim, keep_dim):
     if dim is None:
-        return (1,) if keep_dim else ()
+        # keepdims over all axes preserves rank
+        return (1,) * len(shape) if keep_dim else ()
     dims = (dim,) if isinstance(dim, int) else tuple(dim)
     dims = tuple(d % len(shape) for d in dims)
     if keep_dim:

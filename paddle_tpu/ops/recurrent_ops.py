@@ -18,6 +18,42 @@ from ..core.lod import LoDArray
 from ..core.registry import register_op
 
 
+def _build_carries(ctx, boots, B):
+    """Memory carries from the op's mem_* attrs (+ boot batch validation).
+
+    Shared by recurrent_group / nested_recurrent_group."""
+    carries = []
+    boot_it = iter(boots)
+    for has_boot, shape, init, dt in zip(
+        ctx.attr("mem_has_boot"),
+        [tuple(s_) for s_ in ctx.attr("mem_shape")],
+        ctx.attr("mem_init_value"),
+        ctx.attr("mem_dtype"),
+    ):
+        if has_boot:
+            bv = next(boot_it)
+            bv = bv.data if isinstance(bv, LoDArray) else bv
+            if bv.shape[0] != B:
+                raise ValueError(
+                    f"memory boot batch {bv.shape[0]} != sequence batch {B}"
+                )
+            carries.append(bv)
+        else:
+            carries.append(jnp.full((B,) + shape, init, jnp.dtype(dt)))
+    return carries
+
+
+def _group_rng(ctx, outer_env):
+    """Consume one outer RNG counter for the whole group; per-step fold-in
+
+    of the returned base key gives each frame fresh randomness."""
+    base_key = jax.random.fold_in(
+        outer_env["@RNG@"], outer_env.get("@RNG_COUNTER@", 0)
+    )
+    ctx.env["@RNG_COUNTER@"] = outer_env.get("@RNG_COUNTER@", 0) + 1
+    return base_key
+
+
 @register_op("recurrent_group")
 def recurrent_group_kernel(ctx):
     seqs = ctx.inputs("Seq")
@@ -48,38 +84,15 @@ def recurrent_group_kernel(ctx):
     seq_inner = list(ctx.attr("seq_inner"))
     mem_inner = list(ctx.attr("mem_inner"))
     mem_update = list(ctx.attr("mem_update"))
-    mem_has_boot = list(ctx.attr("mem_has_boot"))
-    mem_shape = [tuple(s) for s in ctx.attr("mem_shape")]
-    mem_init = list(ctx.attr("mem_init_value"))
-    mem_dtype = list(ctx.attr("mem_dtype"))
     out_inner = list(ctx.attr("out_inner"))
 
-    carries = []
-    boot_it = iter(boots)
-    for has_boot, shape, init, dt in zip(
-        mem_has_boot, mem_shape, mem_init, mem_dtype
-    ):
-        if has_boot:
-            bv = next(boot_it)
-            bv = bv.data if isinstance(bv, LoDArray) else bv
-            if bv.shape[0] != B:
-                raise ValueError(
-                    f"memory boot batch {bv.shape[0]} != sequence batch {B}"
-                )
-            carries.append(bv)
-        else:
-            carries.append(jnp.full((B,) + shape, init, jnp.dtype(dt)))
+    carries = _build_carries(ctx, boots, B)
 
     block = ctx.executor.program.blocks[ctx.attr("sub_block")]
     outer_env = dict(ctx.env)  # closure: params, statics, @RNG@/@AMP@
-
-    # per-group RNG stream: consume one counter from the outer stream, then
-    # fold the timestep in so each frame draws fresh randomness (dropout in
+    # per-group RNG stream: each frame draws fresh randomness (dropout in
     # the step body gets a new mask per t, matching per-frame semantics)
-    base_key = jax.random.fold_in(
-        outer_env["@RNG@"], outer_env.get("@RNG_COUNTER@", 0)
-    )
-    ctx.env["@RNG_COUNTER@"] = outer_env.get("@RNG_COUNTER@", 0) + 1
+    base_key = _group_rng(ctx, outer_env)
 
     if is_reverse:
         xs = [jnp.flip(x, axis=0) for x in xs]
@@ -156,78 +169,72 @@ def nested_recurrent_group_kernel(ctx):
     # least one token, so the flat capacity bounds it
     G = C
 
-    sub_ids = first.sub_seq_ids
-    seq_ids = first.seq_ids
-    valid_tok = sub_ids >= 0
-    sub_clip = jnp.where(valid_tok, sub_ids, 0)
+    def sub_layout(sq):
+        """Gather map from THIS input's own (seq_ids, sub_seq_ids):
 
-    sub_len = jnp.zeros((G,), jnp.int32).at[sub_clip].add(
-        valid_tok.astype(jnp.int32))
-    big = jnp.asarray(C, jnp.int32)
-    tok_pos = jnp.arange(C, dtype=jnp.int32)
-    sub_start = jax.ops.segment_min(
-        jnp.where(valid_tok, tok_pos, big), sub_clip, num_segments=G)
-    seq_of_sub = jax.ops.segment_max(
-        jnp.where(valid_tok, seq_ids, -1), sub_clip, num_segments=G)
-    sub_valid = sub_len > 0
-    num_subs = jnp.zeros((B,), jnp.int32).at[
-        jnp.where(sub_valid, seq_of_sub, 0)
-    ].add(sub_valid.astype(jnp.int32))
-    first_sub = jax.ops.segment_min(
-        jnp.where(sub_valid, jnp.arange(G, dtype=jnp.int32), G),
-        jnp.where(sub_valid, seq_of_sub, 0), num_segments=B)
-    first_sub = jnp.where(num_subs > 0, first_sub, 0)
-
-    # gather map: (s, b, l) -> flat token index
-    b_idx = jnp.arange(B, dtype=jnp.int32)[None, :, None]     # [1,B,1]
-    s_idx = jnp.arange(S, dtype=jnp.int32)[:, None, None]     # [S,1,1]
-    l_idx = jnp.arange(L, dtype=jnp.int32)[None, None, :]     # [1,1,L]
-    g = jnp.clip(first_sub[b_idx] + s_idx, 0, G - 1)          # [S,B,1]
-    flat = jnp.clip(sub_start[g] + l_idx, 0, C - 1)           # [S,B,L]
-    tok_mask = (s_idx < num_subs[b_idx]) & (l_idx < sub_len[g])
-
-    step_mask = s_idx[:, :, 0] < num_subs[b_idx[:, :, 0]]     # [S,B]
+        (flat [S,B,L], tok_mask [S,B,L], num_subs [B])."""
+        sub_ids = sq.sub_seq_ids
+        seq_ids = sq.seq_ids
+        valid_tok = sub_ids >= 0
+        sub_clip = jnp.where(valid_tok, sub_ids, 0)
+        sub_len = jnp.zeros((G,), jnp.int32).at[sub_clip].add(
+            valid_tok.astype(jnp.int32))
+        big = jnp.asarray(C, jnp.int32)
+        tok_pos = jnp.arange(C, dtype=jnp.int32)
+        sub_start = jax.ops.segment_min(
+            jnp.where(valid_tok, tok_pos, big), sub_clip, num_segments=G)
+        seq_of_sub = jax.ops.segment_max(
+            jnp.where(valid_tok, seq_ids, -1), sub_clip, num_segments=G)
+        sub_valid = sub_len > 0
+        num_subs = jnp.zeros((B,), jnp.int32).at[
+            jnp.where(sub_valid, seq_of_sub, 0)
+        ].add(sub_valid.astype(jnp.int32))
+        first_sub = jax.ops.segment_min(
+            jnp.where(sub_valid, jnp.arange(G, dtype=jnp.int32), G),
+            jnp.where(sub_valid, seq_of_sub, 0), num_segments=B)
+        first_sub = jnp.where(num_subs > 0, first_sub, 0)
+        # gather map: (s, b, l) -> flat token index
+        b_idx = jnp.arange(B, dtype=jnp.int32)[None, :, None]     # [1,B,1]
+        s_idx = jnp.arange(S, dtype=jnp.int32)[:, None, None]     # [S,1,1]
+        l_idx = jnp.arange(L, dtype=jnp.int32)[None, None, :]     # [1,1,L]
+        g = jnp.clip(first_sub[b_idx] + s_idx, 0, G - 1)          # [S,B,1]
+        flat = jnp.clip(sub_start[g] + l_idx, 0, C - 1)           # [S,B,L]
+        tok_mask = (s_idx < num_subs[b_idx]) & (l_idx < sub_len[g])
+        return flat, tok_mask, num_subs
 
     mem_inner = list(ctx.attr("mem_inner"))
     mem_update = list(ctx.attr("mem_update"))
-    mem_has_boot = list(ctx.attr("mem_has_boot"))
-    mem_shape = [tuple(s_) for s_ in ctx.attr("mem_shape")]
-    mem_init = list(ctx.attr("mem_init_value"))
-    mem_dtype = list(ctx.attr("mem_dtype"))
     seq_inner = list(ctx.attr("seq_inner"))
     seq_inner_mask = list(ctx.attr("seq_inner_mask"))
     out_inner = list(ctx.attr("out_inner"))
 
-    subs = []
+    # derive each input's gather map from its OWN sub-layout and AND the
+    # masks: a misaligned second input must not be sliced at the first
+    # input's boundaries (the reference asserts identical layouts)
+    raw_subs, tok_mask, num_subs = [], None, None
     for sq in seqs:
         if sq.capacity != C or sq.max_seqs != B:
             raise ValueError("nested step inputs must share one LoD layout")
-        d = sq.data[flat]  # [S, B, L, ...]
-        d = jnp.where(
-            tok_mask.reshape(tok_mask.shape + (1,) * (sq.data.ndim - 1)), d, 0)
-        subs.append(d)
+        if sq.sub_seq_ids is None:
+            raise ValueError(
+                "nested_recurrent_group inputs must all be 2-level LoDArrays")
+        flat_i, tm_i, ns_i = sub_layout(sq)
+        raw_subs.append(sq.data[flat_i])  # [S, B, L, ...]
+        tok_mask = tm_i if tok_mask is None else tok_mask & tm_i
+        num_subs = ns_i if num_subs is None else jnp.minimum(num_subs, ns_i)
+    subs = [
+        jnp.where(tok_mask.reshape(tok_mask.shape + (1,) * (d.ndim - 3)), d, 0)
+        for d in raw_subs
+    ]
+    step_mask = (
+        jnp.arange(S, dtype=jnp.int32)[:, None] < num_subs[None, :]
+    )  # [S, B]
 
-    carries = []
-    boot_it = iter(boots)
-    for has_boot, shape, init, dt in zip(
-        mem_has_boot, mem_shape, mem_init, mem_dtype
-    ):
-        if has_boot:
-            bv = next(boot_it)
-            bv = bv.data if isinstance(bv, LoDArray) else bv
-            if bv.shape[0] != B:
-                raise ValueError(
-                    f"memory boot batch {bv.shape[0]} != sequence batch {B}"
-                )
-            carries.append(bv)
-        else:
-            carries.append(jnp.full((B,) + shape, init, jnp.dtype(dt)))
+    carries = _build_carries(ctx, boots, B)
 
     block = ctx.executor.program.blocks[ctx.attr("sub_block")]
     outer_env = dict(ctx.env)
-    base_key = jax.random.fold_in(
-        outer_env["@RNG@"], outer_env.get("@RNG_COUNTER@", 0))
-    ctx.env["@RNG_COUNTER@"] = outer_env.get("@RNG_COUNTER@", 0) + 1
+    base_key = _group_rng(ctx, outer_env)
 
     def body(carry, step):
         step_subs, step_tok_mask, m, t = step

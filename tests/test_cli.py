@@ -1,0 +1,105 @@
+"""CLI tests (`python -m paddle_tpu ...`).
+
+Reference analogue: the `paddle train` shell command
+(scripts/submit_local.sh.in:177-180) driving TrainerMain with a config
+file — here the config is a Python module defining get_model().
+"""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+CONFIG = """
+import numpy as np
+import paddle_tpu as pt
+
+def get_model():
+    x = pt.layers.data("x", shape=[4])
+    y = pt.layers.data("y", shape=[1])
+    pred = pt.layers.fc(x, size=1)
+    loss = pt.layers.mean(pt.layers.square_error_cost(pred, y))
+    pt.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    rng = np.random.RandomState(0)
+    w = rng.randn(4, 1).astype(np.float32)
+
+    def reader():
+        for _ in range(8):
+            xs = rng.randn(16, 4).astype(np.float32)
+            yield {"x": xs, "y": xs @ w}
+
+    return {"cost": loss, "reader": reader, "num_passes": 3}
+"""
+
+
+def _run(args, cwd):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    return subprocess.run(
+        [sys.executable, "-m", "paddle_tpu"] + args,
+        capture_output=True, text=True, cwd=cwd, env=env, timeout=240,
+    )
+
+
+def test_cli_train(tmp_path):
+    cfg = tmp_path / "model.py"
+    cfg.write_text(CONFIG)
+    r = _run(["train", "--config", str(cfg), "--save_dir", ""], str(tmp_path))
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "final:" in r.stdout
+    # cost decreased over the run
+    assert "Pass 2 done" in r.stdout
+
+
+def test_cli_flags_and_version(tmp_path):
+    r = _run(["flags"], str(tmp_path))
+    assert r.returncode == 0 and "--check_nan_inf" in r.stdout
+    r = _run(["version"], str(tmp_path))
+    assert r.returncode == 0 and r.stdout.strip()
+
+
+def test_cli_unknown_command(tmp_path):
+    r = _run(["frobnicate"], str(tmp_path))
+    assert r.returncode != 0
+
+
+INFER_CONFIG = CONFIG + """
+
+def get_inference():
+    import paddle_tpu as pt
+    pt.reset()
+    x = pt.layers.data("x", shape=[4])
+    pred = pt.layers.fc(x, size=1)
+    return ["x"], [pred]
+"""
+
+
+def test_cli_train_checkpoint_merge_infer_roundtrip(tmp_path):
+    """Full deploy flow: train with checkpoints -> merge_model -> load the
+
+    inference model and predict (MergeModel.cpp + capi flow parity)."""
+    cfg = tmp_path / "model.py"
+    cfg.write_text(INFER_CONFIG)
+    ckpt = tmp_path / "ckpt"
+    r = _run(["train", "--config", str(cfg), "--save_dir", str(ckpt)],
+             str(tmp_path))
+    assert r.returncode == 0, r.stderr[-2000:]
+    out = tmp_path / "deploy"
+    r = _run(["merge_model", "--config", str(cfg), "--model_dir", str(ckpt),
+              "--out", str(out)], str(tmp_path))
+    assert r.returncode == 0, r.stderr[-2000:]
+    # load and run the merged model in-process
+    import paddle_tpu as pt
+
+    pt.reset()
+    prog, feed_names, fetch_names = pt.io.load_inference_model(str(out))
+    exe = pt.Executor()
+    (pred,) = exe.run(prog,
+                      feed={feed_names[0]: np.ones((2, 4), np.float32)},
+                      fetch_list=fetch_names)
+    assert pred.shape == (2, 1) and np.all(np.isfinite(pred))

@@ -458,3 +458,18 @@ def test_roi_pool_overlapping_bins():
     (out,) = _run([rp], {"x": xv, "rois": rv})
     # pixel (2,2) must appear in every bin's max (reference semantics)
     np.testing.assert_allclose(out[0, 0], [[99, 99], [99, 99]])
+
+
+def test_reduce_keep_dim_static_shape_and_value():
+    """reduce with dim=None keep_dim=True keeps rank (declared == runtime)."""
+    x = pt.layers.data("x", shape=[2, 3], append_batch_size=False)
+    r = pt.layers.reduce_sum(x, keep_dim=True)
+    assert tuple(r.shape) == (1, 1)
+    r2 = pt.layers.reduce_sum(x, dim=1, keep_dim=True)
+    assert tuple(r2.shape) == (2, 1)
+    exe = pt.Executor()
+    out, out2 = exe.run(
+        feed={"x": np.arange(6, dtype=np.float32).reshape(2, 3)},
+        fetch_list=[r, r2])
+    assert out.shape == (1, 1) and out[0, 0] == 15
+    assert out2.shape == (2, 1)
