@@ -64,7 +64,10 @@ def _cmd_train(argv) -> int:
         raise SystemExit("train requires --config <model.py>")
     model = _load_config(cfg["config"])
     num_passes = int(cfg.get("num_passes", model.get("num_passes", 1)))
-    save_dir = cfg.get("save_dir", FLAGS.save_dir)
+    # checkpointing (and its auto-resume) only when the user asks for it:
+    # a default dir would make a rerun of a finished job silently resume
+    # past the last pass and train nothing
+    save_dir = cfg.get("save_dir", "")
     ckpt = CheckpointConfig(checkpoint_dir=save_dir) if save_dir else None
     trainer = Trainer(cost=model["cost"], checkpoint_config=ckpt)
 

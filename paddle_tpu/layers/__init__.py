@@ -5,6 +5,8 @@ front-end over core.program + ops."""
 
 from .attention import *  # noqa: F401,F403
 from .attention import __all__ as _att_all
+from .control_flow import *  # noqa: F401,F403
+from .control_flow import __all__ as _cf_all
 from .crf import *  # noqa: F401,F403
 from .crf import __all__ as _crf_all
 from .ctc import *  # noqa: F401,F403
@@ -24,5 +26,5 @@ from .sequence import __all__ as _seq_all
 
 __all__ = (
     list(_nn_all) + list(_seq_all) + list(_att_all) + list(_crf_all)
-    + list(_ctc_all) + list(_misc_all) + list(_det_all) + list(_rec_all) + list(_gen_all)
+    + list(_ctc_all) + list(_misc_all) + list(_det_all) + list(_rec_all) + list(_gen_all) + list(_cf_all)
 )

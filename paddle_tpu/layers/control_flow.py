@@ -1,0 +1,157 @@
+"""Generic control flow: While loops and conditional branches.
+
+Reference: paddle/operators/while_op.cc (block-attr subprogram looped while
+a bool condition var holds), conditional_block_op.cc / cond_op.cc (branch
+subprograms), and the Fluid `While` / `layers.cond` front-ends
+(python/paddle/v2/fluid/layers/control_flow.py). The dynamic-RNN stack the
+reference builds FROM While (lod_rank_table / shrink_rnn_memory) is covered
+by recurrent_group; this module is the general machinery.
+
+TPU design: sub-blocks traced into `jax.lax.while_loop` / `jax.lax.cond`
+bodies — compiled control flow, no host round-trips. While-carried values
+are declared functionally via `loop.update(outer_var, new_var)` instead of
+in-place assigns; reads of the outer var inside the block see the carried
+value.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..core.program import Variable, default_main_program, unique_name
+from .helper import LayerHelper
+
+__all__ = ["While", "cond"]
+
+
+class While:
+    """Compiled while-loop over a sub-block.
+
+    Usage::
+
+        i = pt.layers.fill_constant([1], np.int32, 0)
+        s = pt.layers.fill_constant([1], np.float32, 0.0)
+        c = pt.layers.less_than(i, n)          # initial condition
+        loop = pt.layers.While(cond=c)
+        with loop.block():
+            i2 = pt.layers.increment(i)        # reads see carried values
+            s2 = pt.layers.elementwise_add(s, x)
+            loop.update(i, i2)
+            loop.update(s, s2)
+            loop.update(c, pt.layers.less_than(i2, n))
+        i_fin, s_fin, _ = loop()               # finals, update order
+
+    The condition is an updated loop var: its value entering the op
+    decides iteration 1, the value computed in the block decides the next
+    — exactly the reference While semantics (cond computed before the op,
+    recomputed at block end).
+
+    NOT reverse-mode differentiable (lax.while_loop limitation — an
+    unbounded loop cannot be rematerialized on TPU): use it for inference/
+    decoding/data logic. Trainable recurrences belong in recurrent_group
+    (bounded scan), which is also how the reference's trainable dynamic
+    RNNs are built on top of while_op rather than raw while backward."""
+
+    def __init__(self, cond: Variable, name=None):
+        self.helper = LayerHelper("while_loop", name=name)
+        self.cond = cond
+        self._updates: List[Tuple[Variable, Variable]] = []
+        self._block = None
+        self._done = False
+
+    @contextlib.contextmanager
+    def block(self):
+        prog = self.helper.main_program
+        with prog.block_guard() as b:
+            self._block = b
+            yield
+        self._complete()
+
+    def update(self, outer: Variable, new: Variable) -> None:
+        """Declare a loop-carried value: inside the block, reads of
+
+        `outer` see the carried value; after the loop, its final value is
+        returned. The condition var itself must be updated or the loop
+        never terminates."""
+        for o, _ in self._updates:
+            if o.name == outer.name:
+                raise ValueError(f"{outer.name} updated twice")
+        self._updates.append((outer, new))
+
+    def _complete(self):
+        if not any(o.name == self.cond.name for o, _ in self._updates):
+            raise ValueError(
+                "While condition var must be updated inside the block "
+                "(otherwise the loop cannot terminate)")
+        helper = self.helper
+        parent = helper.block
+        self.outputs = [
+            parent.create_var(
+                unique_name(f"{helper.name}.out"), tuple(o.shape), o.dtype
+            )
+            for o, _ in self._updates
+        ]
+        parent.append_op(
+            "while_loop",
+            inputs={
+                "Cond": [self.cond.name],
+                "Carried": [o.name for o, _ in self._updates],
+            },
+            outputs={"Out": [v.name for v in self.outputs]},
+            attrs={
+                "sub_block": self._block.idx,
+                "carried": [o.name for o, _ in self._updates],
+                "updates": [n.name for _, n in self._updates],
+            },
+        )
+        self._done = True
+
+    def __call__(self):
+        if not self._done:
+            raise RuntimeError("call after the block() has closed")
+        return tuple(self.outputs)
+
+
+def cond(pred: Variable, true_fn, false_fn, name=None):
+    """Compiled two-way branch (reference: conditional_block_op.cc /
+
+    cond_op.cc; modern fluid layers.cond). `true_fn`/`false_fn` build
+    their sub-networks in separate sub-blocks and return a Variable or a
+    tuple of Variables with matching shapes/dtypes; both branches run
+    under lax.cond's tracing but only one executes."""
+    helper = LayerHelper("cond", name=name)
+    prog = helper.main_program
+
+    def trace(fn):
+        with prog.block_guard() as b:
+            outs = fn()
+        if not isinstance(outs, (list, tuple)):
+            outs = [outs]
+        return b, list(outs)
+
+    tb, t_outs = trace(true_fn)
+    fb, f_outs = trace(false_fn)
+    if len(t_outs) != len(f_outs):
+        raise ValueError("cond branches must return the same number of vars")
+    parent = helper.block
+    outputs = [
+        parent.create_var(
+            unique_name(f"{helper.name}.out"), tuple(v.shape), v.dtype
+        )
+        for v in t_outs
+    ]
+    parent.append_op(
+        "cond",
+        inputs={"Pred": [pred.name]},
+        outputs={"Out": [v.name for v in outputs]},
+        attrs={
+            "true_block": tb.idx,
+            "false_block": fb.idx,
+            "true_outs": [v.name for v in t_outs],
+            "false_outs": [v.name for v in f_outs],
+        },
+    )
+    return outputs[0] if len(outputs) == 1 else tuple(outputs)

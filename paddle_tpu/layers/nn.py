@@ -47,6 +47,14 @@ __all__ = [
     "fill_constant",
     "increment",
     "clip",
+    "less_than",
+    "less_equal",
+    "greater_than",
+    "greater_equal",
+    "equal",
+    "not_equal",
+    "logical_and",
+    "logical_not",
     "topk",
     "argmax",
     "lrn",
@@ -610,3 +618,65 @@ def argmax(x, axis=-1):
 
 def lrn(input, n=5, k=2.0, alpha=1e-4, beta=0.75):
     return _unary("lrn", input, {"n": n, "k": k, "alpha": alpha, "beta": beta})
+
+
+def _broadcast_static_shape(a, b):
+    """numpy broadcast over static shapes where -1 is an unknown dim."""
+    a, b = tuple(a), tuple(b)
+    n = max(len(a), len(b))
+    a = (1,) * (n - len(a)) + a
+    b = (1,) * (n - len(b)) + b
+    out = []
+    for da, db in zip(a, b):
+        if da == -1 or db == -1:
+            out.append(-1 if max(da, db) in (-1, 1) else max(da, db))
+        else:
+            out.append(max(da, db))
+    return tuple(out)
+
+
+def _compare_layer(op_type, x, y):
+    helper = LayerHelper(op_type)
+    out = helper.create_tmp_variable(
+        np.bool_, _broadcast_static_shape(x.shape, y.shape), x.lod_level
+    )
+    helper.append_op(type=op_type, inputs={"X": [x], "Y": [y]},
+                     outputs={"Out": [out]})
+    return out
+
+
+def less_than(x, y):
+    """Reference: operators/compare_op.cc (fluid layers.less_than)."""
+    return _compare_layer("less_than", x, y)
+
+
+def less_equal(x, y):
+    return _compare_layer("less_equal", x, y)
+
+
+def greater_than(x, y):
+    return _compare_layer("greater_than", x, y)
+
+
+def greater_equal(x, y):
+    return _compare_layer("greater_equal", x, y)
+
+
+def equal(x, y):
+    return _compare_layer("equal", x, y)
+
+
+def not_equal(x, y):
+    return _compare_layer("not_equal", x, y)
+
+
+def logical_and(x, y):
+    return _compare_layer("logical_and", x, y)
+
+
+def logical_not(x):
+    helper = LayerHelper("logical_not")
+    out = helper.create_tmp_variable(np.bool_, x.shape, x.lod_level)
+    helper.append_op(type="logical_not", inputs={"X": [x]},
+                     outputs={"Out": [out]})
+    return out

@@ -8,6 +8,7 @@ traced program instead of hand-written grad kernels.
 
 from . import activation_ops  # noqa: F401
 from . import attention_ops  # noqa: F401
+from . import control_flow_ops  # noqa: F401
 from . import cost_ops  # noqa: F401
 from . import crf_ops  # noqa: F401
 from . import misc_ops  # noqa: F401

@@ -1,0 +1,87 @@
+"""While / cond control-flow tests.
+
+Reference analogues: fluid tests test_while_op.py, test_conditional_block.py
+— compiled loops/branches over sub-blocks must match plain-python results
+and train (gradients through lax.cond branches).
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+
+
+def test_while_sums_first_n():
+    """sum(0..n-1) with a compiled while loop."""
+    n = pt.layers.data("n", shape=[1], dtype=np.int32, append_batch_size=False)
+    i = pt.layers.fill_constant([1], np.int32, 0)
+    s = pt.layers.fill_constant([1], np.int32, 0)
+    c = pt.layers.less_than(i, n)
+    loop = pt.layers.While(cond=c)
+    with loop.block():
+        s2 = pt.layers.elementwise_add(s, i)
+        i2 = pt.layers.increment(i)
+        loop.update(i, i2)
+        loop.update(s, s2)
+        loop.update(c, pt.layers.less_than(i2, n))
+    i_fin, s_fin, _ = loop()
+    exe = pt.Executor()
+    for nv, want in [(5, 10), (1, 0), (0, 0)]:
+        iv, sv = exe.run(
+            feed={"n": np.array([nv], np.int32)}, fetch_list=[i_fin, s_fin]
+        )
+        assert sv[0] == want, (nv, sv)
+        assert iv[0] == nv
+
+
+def test_while_requires_cond_update():
+    i = pt.layers.fill_constant([1], np.int32, 0)
+    c = pt.layers.less_than(i, pt.layers.fill_constant([1], np.int32, 3))
+    loop = pt.layers.While(cond=c)
+    with pytest.raises(ValueError, match="condition var must be updated"):
+        with loop.block():
+            loop.update(i, pt.layers.increment(i))
+
+
+def test_cond_selects_branch():
+    x = pt.layers.data("x", shape=[1, 2], append_batch_size=False)
+    p = pt.layers.data("p", shape=[1], dtype=np.bool_, append_batch_size=False)
+    out = pt.layers.cond(
+        p,
+        lambda: pt.layers.scale(x, scale=2.0),
+        lambda: pt.layers.scale(x, scale=-1.0),
+    )
+    exe = pt.Executor()
+    xv = np.array([[1.0, 3.0]], np.float32)
+    (a,) = exe.run(feed={"x": xv, "p": np.array([True])}, fetch_list=[out])
+    (b,) = exe.run(feed={"x": xv, "p": np.array([False])}, fetch_list=[out])
+    np.testing.assert_allclose(a, xv * 2)
+    np.testing.assert_allclose(b, -xv)
+
+
+def test_cond_gradients_flow():
+    """Grads flow through the taken branch only."""
+    x = pt.layers.data("x", shape=[4])
+    p = pt.layers.data("p", shape=[1], dtype=np.bool_, append_batch_size=False)
+    y = pt.layers.data("y", shape=[1])
+    h1 = pt.layers.fc(x, size=1, param_attr="w_true")
+    h2 = pt.layers.fc(x, size=1, param_attr="w_false")
+    out = pt.layers.cond(p, lambda: pt.layers.scale(h1, 1.0),
+                         lambda: pt.layers.scale(h2, 1.0))
+    loss = pt.layers.mean(pt.layers.square_error_cost(out, y))
+    pt.optimizer.SGD(learning_rate=0.5).minimize(loss)
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    scope = pt.global_scope()
+    w_false_before = np.asarray(scope.get("w_false")).copy()
+    rng = np.random.RandomState(0)
+    feed = {"x": rng.randn(8, 4).astype(np.float32),
+            "y": rng.randn(8, 1).astype(np.float32),
+            "p": np.array([True])}
+    for _ in range(3):
+        exe.run(feed=feed, fetch_list=[loss])
+    # only the taken branch's weight moved
+    assert not np.allclose(np.asarray(scope.get("w_true")),
+                           np.zeros_like(w_false_before))
+    np.testing.assert_allclose(np.asarray(scope.get("w_false")),
+                               w_false_before)
