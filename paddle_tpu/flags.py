@@ -103,7 +103,10 @@ define_flag("show_param_stats_period", 0,
             "trainer: dump per-parameter value/gradient stats every N "
             "batches (reference: TrainerInternal.cpp:81-109); 0 = off")
 define_flag("beam_size", 7, "default beam width for beam-search decode")
-define_flag("save_dir", "./output", "default checkpoint directory")
+define_flag("save_dir", "./output",
+            "conventional checkpoint directory; checkpointing itself is "
+            "enabled per-run (CLI: train --save_dir; API: "
+            "Trainer(checkpoint_config=...))")
 define_flag("enable_timers", False,
             "accumulate REGISTER_TIMER-style stat timers "
             "(reference: utils/Stat.h, WITH_TIMER)")

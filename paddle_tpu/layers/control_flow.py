@@ -76,6 +76,11 @@ class While:
         `outer` see the carried value; after the loop, its final value is
         returned. The condition var itself must be updated or the loop
         never terminates."""
+        if self._done:
+            raise RuntimeError(
+                "update() after the block() has closed — the loop op is "
+                "already emitted; declare all carried values inside the "
+                "with-block")
         for o, _ in self._updates:
             if o.name == outer.name:
                 raise ValueError(f"{outer.name} updated twice")

@@ -228,6 +228,15 @@ class Optimizer:
         acc = helper.main_program.global_block().create_var(
             acc_name, tuple(shape), param.dtype, persistable=True
         )
+        # marks the var as shardable optimizer state (ZeRO-style, see
+        # parallel/data_parallel.py shard_optimizer_state)
+        acc.is_optimizer_state = True
+        # a param with its own sharding (e.g. mp-sharded embedding) passes
+        # it to same-shaped accumulators — state stays co-located with the
+        # param instead of being re-sharded over dp every step
+        pspec = getattr(param, "sharding", None)
+        if pspec is not None and tuple(shape) == tuple(param.shape):
+            acc.sharding = pspec
         ConstantInitializer(fill)(acc, helper.startup_program)
         self._accumulators.setdefault(name, {})[param.name] = acc
         return acc

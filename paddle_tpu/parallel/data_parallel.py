@@ -43,18 +43,43 @@ class ParallelExecutor(Executor):
     unless a Variable carries `.sharding` (a PartitionSpec) — e.g. a vocab-
     sharded embedding table (parallel/sharded_embedding.py)."""
 
-    def __init__(self, mesh: Optional[Mesh] = None, batch_axis: str = DP):
+    def __init__(
+        self,
+        mesh: Optional[Mesh] = None,
+        batch_axis: str = DP,
+        shard_optimizer_state: bool = False,
+    ):
         super().__init__()
         self.mesh = mesh or make_mesh()
         self.batch_axis = batch_axis
+        # ZeRO-1 expressed as GSPMD (SURVEY.md §5.8: "sharded optimizer
+        # state replaces the pserver's parameter-block sharding"): optimizer
+        # accumulators are sharded over the dp axis; XLA keeps their update
+        # shard-local and inserts the all-gather on the state→param path.
+        # HBM for optimizer state drops by ~dp_size.
+        self.shard_optimizer_state = shard_optimizer_state
 
     # -- sharding rules -----------------------------------------------------
     def _state_sharding(self, program: Program, name: str) -> NamedSharding:
         gb = program.global_block()
         if name in gb.vars:
-            spec = getattr(gb.vars[name], "sharding", None)
+            var = gb.vars[name]
+            spec = getattr(var, "sharding", None)
             if spec is not None:
                 return NamedSharding(self.mesh, spec)
+            if (
+                self.shard_optimizer_state
+                and getattr(var, "is_optimizer_state", False)
+                and len(var.shape) >= 1
+                and var.shape[0] != -1
+                and var.shape[0] % self.mesh.shape[self.batch_axis] == 0
+            ):
+                return NamedSharding(
+                    self.mesh,
+                    PartitionSpec(
+                        self.batch_axis, *([None] * (len(var.shape) - 1))
+                    ),
+                )
         return NamedSharding(self.mesh, PartitionSpec())
 
     def _feed_sharding(self, value) -> Any:

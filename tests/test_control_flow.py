@@ -74,6 +74,7 @@ def test_cond_gradients_flow():
     exe.run(pt.default_startup_program())
     scope = pt.global_scope()
     w_false_before = np.asarray(scope.get("w_false")).copy()
+    w_true_before = np.asarray(scope.get("w_true")).copy()
     rng = np.random.RandomState(0)
     feed = {"x": rng.randn(8, 4).astype(np.float32),
             "y": rng.randn(8, 1).astype(np.float32),
@@ -81,7 +82,6 @@ def test_cond_gradients_flow():
     for _ in range(3):
         exe.run(feed=feed, fetch_list=[loss])
     # only the taken branch's weight moved
-    assert not np.allclose(np.asarray(scope.get("w_true")),
-                           np.zeros_like(w_false_before))
+    assert not np.allclose(np.asarray(scope.get("w_true")), w_true_before)
     np.testing.assert_allclose(np.asarray(scope.get("w_false")),
                                w_false_before)
