@@ -23,6 +23,7 @@ from . import amp  # noqa: F401
 from .amp import amp_guard  # noqa: F401
 from . import flags  # noqa: F401
 from .flags import FLAGS, define_flag, parse_flags  # noqa: F401
+from . import plot  # noqa: F401
 from . import profiler  # noqa: F401
 from . import core  # noqa: F401
 from . import ops  # noqa: F401  (registers all kernels)
@@ -66,3 +67,28 @@ def reset():
     """Fresh default programs + scope (test isolation helper)."""
     reset_default_programs()
     reset_global_scope()
+
+
+def init(seed: int = 0, distributed: bool = False, **flag_overrides):
+    """Reference API: `paddle.init(use_gpu=..., trainer_count=...)`
+
+    (python/paddle/v2/__init__.py init — kwargs became gflags). Here:
+    kwargs set registry flags (unknown names raise, atomically — nothing
+    is applied if any name is unknown), `seed` seeds FLAGS.seed and the
+    default programs, `distributed=True` runs jax.distributed
+    initialization for multi-host (the etcd-membership parity)."""
+    from .flags import _REGISTRY
+
+    unknown = [k for k in flag_overrides if k not in _REGISTRY]
+    if unknown:
+        raise AttributeError(f"undefined flags {unknown}")
+    for k, v in flag_overrides.items():
+        setattr(FLAGS, k, v)
+    if seed:
+        FLAGS.seed = seed
+        default_main_program().random_seed = seed
+        default_startup_program().random_seed = seed
+    if distributed:
+        from .parallel import init_distributed
+
+        init_distributed()

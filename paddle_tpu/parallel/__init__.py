@@ -5,6 +5,19 @@ allreduce, C++/Go parameter servers, DistributeTranspiler, NCCL ops, gRPC
 send/recv, etcd membership — collapses into sharding annotations over a
 jax.sharding.Mesh plus XLA collectives on ICI/DCN. See data_parallel.py
 for the mapping table.
+
+Seams beyond reference parity (SURVEY.md §2.3 last row — absent in the
+2017 reference, axes reserved so they can be added without redesign):
+- mesh.py names `SP`/`PP` axes alongside `DP`/`MP`. Sequence/context
+  parallelism (ring attention, Ulysses all-to-all) would shard the
+  LoDArray flat-token axis over `SP` — the LoD segment metadata already
+  travels with the data (data_parallel.py `_feed_sharding` shows the
+  per-leaf annotation point), and `collective.ppermute_ring` is the ring
+  primitive a ring-attention block would use over that axis.
+- Pipeline parallelism would assign program sub-ranges to `PP` stages;
+  the Program IR's block structure (core/program.py) is the natural cut
+  point, mirroring how ParallelNeuralNetwork used per-layer `device`
+  attrs (ModelConfig.proto:399).
 """
 
 from .collective import (  # noqa: F401

@@ -1,0 +1,69 @@
+"""Training-curve plotting (reference: python/paddle/v2/plot/plot.py
+
+`Ploter` for notebooks + python/paddle/utils/plotcurve.py for logs).
+
+Collects (step, value) series per title and renders with matplotlib when
+available; in a headless/minimal environment it degrades to an aligned
+text table so the data is never lost."""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+__all__ = ["Ploter"]
+
+
+class Ploter:
+    def __init__(self, *titles: str):
+        self.titles = list(titles)
+        self.data: Dict[str, List[Tuple[float, float]]] = {
+            t: [] for t in titles
+        }
+        self._fig = None
+
+    def append(self, title: str, step, value) -> None:
+        if title not in self.data:
+            raise KeyError(f"unknown series {title!r}; have {self.titles}")
+        self.data[title].append((float(step), float(value)))
+
+    def reset(self) -> None:
+        for t in self.titles:
+            self.data[t] = []
+
+    def plot(self, path: Optional[str] = None):
+        """Render the curves. With `path`: write a png (or, without
+
+        matplotlib, a text table) and return `path`. Without `path`:
+        return the matplotlib figure (or the text table). The figure is
+        reused across calls, so re-plotting every log period (the
+        reference Ploter pattern) doesn't leak figures."""
+        try:
+            # savefig works on any backend; deliberately do NOT call
+            # matplotlib.use("Agg") — switching the global backend would
+            # kill inline rendering for the whole process in a notebook
+            import matplotlib.pyplot as plt
+
+            if self._fig is not None:
+                plt.close(self._fig)
+            self._fig, ax = plt.subplots()
+            for t in self.titles:
+                if self.data[t]:
+                    xs, ys = zip(*self.data[t])
+                    ax.plot(xs, ys, label=t)
+            ax.set_xlabel("step")
+            ax.legend()
+            if path:
+                self._fig.savefig(path)
+                return path
+            return self._fig
+        except ImportError:
+            lines = []
+            for t in self.titles:
+                for s, v in self.data[t]:
+                    lines.append(f"{t}\t{s:g}\t{v:g}")
+            out = "\n".join(lines)
+            if path:
+                with open(path, "w") as f:
+                    f.write(out + "\n")
+                return path
+            return out

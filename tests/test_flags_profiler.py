@@ -142,3 +142,29 @@ def test_profiler_context_smoke(tmp_path):
         import jax.numpy as jnp
 
         (jnp.ones((4,)) * 2).block_until_ready()
+
+
+def test_init_api_and_ploter(tmp_path, monkeypatch):
+    """v2 paddle.init parity + plot.Ploter parity."""
+    monkeypatch.setattr(FLAGS, "log_period", FLAGS.log_period)  # restore after
+    monkeypatch.setattr(FLAGS, "seed", FLAGS.seed)
+    pt.init(seed=42, log_period=7)
+    assert FLAGS.log_period == 7 and FLAGS.seed == 42
+    assert pt.default_main_program().random_seed == 42
+    # atomic: an unknown flag applies nothing
+    with pytest.raises(AttributeError):
+        pt.init(enable_timers=True, not_a_flag=1)
+    assert FLAGS.enable_timers is False
+
+    from paddle_tpu.plot import Ploter
+
+    p = Ploter("train_cost", "test_cost")
+    p.append("train_cost", 0, 1.5)
+    p.append("train_cost", 1, 1.2)
+    p.append("test_cost", 1, 1.3)
+    out = p.plot(str(tmp_path / "curve.png"))
+    assert out == str(tmp_path / "curve.png")  # path in both branches
+    with pytest.raises(KeyError):
+        p.append("nope", 0, 0.0)
+    p.reset()
+    assert not p.data["train_cost"]
