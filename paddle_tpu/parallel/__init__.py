@@ -8,12 +8,11 @@ for the mapping table.
 
 Seams beyond reference parity (SURVEY.md §2.3 last row — absent in the
 2017 reference, axes reserved so they can be added without redesign):
-- mesh.py names `SP`/`PP` axes alongside `DP`/`MP`. Sequence/context
-  parallelism (ring attention, Ulysses all-to-all) would shard the
-  LoDArray flat-token axis over `SP` — the LoD segment metadata already
-  travels with the data (data_parallel.py `_feed_sharding` shows the
-  per-leaf annotation point), and `collective.ppermute_ring` is the ring
-  primitive a ring-attention block would use over that axis.
+- ring_attention.py implements sequence/context parallelism over the
+  `SP` axis (K/V shards rotate via ppermute with online-softmax
+  accumulation — O(T_local) memory per chip). Ragged inputs would shard
+  the LoDArray flat-token axis the same way (data_parallel.py
+  `_feed_sharding` is the per-leaf annotation point).
 - Pipeline parallelism would assign program sub-ranges to `PP` stages;
   the Program IR's block structure (core/program.py) is the natural cut
   point, mirroring how ParallelNeuralNetwork used per-layer `device`
@@ -37,4 +36,8 @@ from .distributed import (  # noqa: F401
     process_index,
 )
 from .mesh import DP, MP, PP, SP, batch_sharded, dim_sharded, make_mesh, replicated  # noqa: F401
+from .ring_attention import (  # noqa: F401
+    ring_attention,
+    scaled_dot_product_attention,
+)
 from .sharded_embedding import sharded_embedding  # noqa: F401

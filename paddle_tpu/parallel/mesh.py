@@ -8,7 +8,7 @@ over ICI; axis names are the vocabulary the rest of the framework uses:
 
   dp — data parallel (batch)            ≙ trainer_count / num trainers
   mp — model parallel (sharded params)  ≙ pserver parameter blocks
-  sp — sequence parallel (long context) — seam, see parallel/context.py
+  sp — sequence parallel (long context) — parallel/ring_attention.py
   pp — pipeline stages                  ≙ ParallelNeuralNetwork device attr
 """
 

@@ -34,9 +34,11 @@ class Ploter:
         """Render the curves. With `path`: write a png (or, without
 
         matplotlib, a text table) and return `path`. Without `path`:
-        return the matplotlib figure (or the text table). The figure is
-        reused across calls, so re-plotting every log period (the
-        reference Ploter pattern) doesn't leak figures."""
+        return the matplotlib figure (or the text table). The previous
+        figure is closed before drawing a new one, so re-plotting every
+        log period (the reference Ploter pattern) doesn't leak figures —
+        note a figure handle returned earlier is therefore dead after
+        the next plot() call."""
         try:
             # savefig works on any backend; deliberately do NOT call
             # matplotlib.use("Agg") — switching the global backend would
