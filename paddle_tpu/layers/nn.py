@@ -224,13 +224,18 @@ def conv2d(
 
 
 def conv2d_transpose(
-    input, num_filters, filter_size, stride=1, padding=0, param_attr=None, name=None
+    input, num_filters, filter_size, stride=1, padding=0, param_attr=None,
+    bias_attr=None, act: Optional[str] = None, name=None
 ) -> Variable:
     helper = LayerHelper("conv2d_transpose", name=name)
     in_c = input.shape[1]
     fh, fw = _pair_(filter_size)
     w = helper.create_parameter(param_attr, (in_c, num_filters, fh, fw))
     s, p = _pair_(stride), _pair_(padding)
+    inputs = {"Input": [input], "Filter": [w]}
+    if bias_attr is not False:
+        b = helper.create_parameter(bias_attr, (num_filters,), is_bias=True)
+        inputs["Bias"] = [b]
     out_hw = tuple(
         -1 if input.shape[2 + i] == -1
         else (input.shape[2 + i] - 1) * s[i] - 2 * p[i] + (fh, fw)[i]
@@ -239,11 +244,11 @@ def conv2d_transpose(
     out = helper.create_tmp_variable(input.dtype, (-1, num_filters) + out_hw)
     helper.append_op(
         type="conv2d_transpose",
-        inputs={"Input": [input], "Filter": [w]},
+        inputs=inputs,
         outputs={"Output": [out]},
         attrs={"strides": stride, "paddings": padding},
     )
-    return out
+    return helper.append_activation(out, act)
 
 
 def pool2d(

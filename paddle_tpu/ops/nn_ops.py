@@ -60,23 +60,32 @@ def conv2d_kernel(ctx):
 
 @register_op("conv2d_transpose")
 def conv2d_transpose_kernel(ctx):
-    """Reference: paddle/operators/conv_transpose_op.cc."""
+    """Reference: paddle/operators/conv_transpose_op.cc — Filter layout
+
+    [in_c, out_c, kh, kw]. Expressed as the fractionally-strided conv:
+    lhs dilated by the stride, spatially-flipped kernel in OIHW, padding
+    k-1-p (verified element-wise against torch's conv_transpose2d)."""
     x = ctx.input("Input")
     w = ctx.input("Filter")  # [in_c, out_c, kh, kw]
     stride = _pair(ctx.attr("strides", (1, 1)))
     pad = _pair(ctx.attr("paddings", (0, 0)))
+    kh, kw = w.shape[2], w.shape[3]
+    wk = jnp.transpose(w, (1, 0, 2, 3))[:, :, ::-1, ::-1]  # OIHW, flipped
     dtype = x.dtype
-    xc, wc = amp.cast_inputs(ctx, x, jnp.transpose(w, (1, 0, 2, 3)))
+    xc, wc = amp.cast_inputs(ctx, x, wk)
     acc = jnp.float32 if xc.dtype == jnp.float32 else None
-    out = jax.lax.conv_transpose(
+    out = jax.lax.conv_general_dilated(
         xc,
         wc,
-        strides=stride,
-        padding=[(pad[0], pad[0]), (pad[1], pad[1])],
+        window_strides=(1, 1),
+        padding=[(kh - 1 - pad[0], kh - 1 - pad[0]),
+                 (kw - 1 - pad[1], kw - 1 - pad[1])],
+        lhs_dilation=stride,
         dimension_numbers=("NCHW", "OIHW", "NCHW"),
-        transpose_kernel=True,
         preferred_element_type=acc,
     ).astype(dtype)
+    if ctx.has_input("Bias"):
+        out = out + ctx.input("Bias").reshape((1, -1, 1, 1))
     ctx.set_output("Output", out)
 
 

@@ -24,6 +24,10 @@ if "xla_force_host_platform_device_count" not in xla_flags:
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+# full-f32 matmul/conv numerics for the oracle comparisons (XLA CPU's
+# default conv precision is reduced — SURVEY.md §7 hard part 7: keep a
+# faithful CPU reference path for tests)
+jax.config.update("jax_default_matmul_precision", "highest")
 
 import pytest  # noqa: E402
 

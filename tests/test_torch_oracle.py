@@ -1,0 +1,174 @@
+"""Cross-implementation oracles against torch CPU.
+
+Reference test strategy §4.2 (SURVEY.md): every kernel family checked
+against an independent implementation (there: CPU vs GPU / plain vs MKLDNN;
+here: XLA vs torch CPU) — conv/conv_transpose (forward + weight grads,
+bias/act paths), pool, batch_norm (train stats).
+"""
+
+import numpy as np
+import pytest
+import torch
+import torch.nn.functional as F
+
+import paddle_tpu as pt
+
+
+def _run(build, feeds, fetch):
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    return exe.run(feed=feeds, fetch_list=fetch)
+
+
+@pytest.mark.parametrize(
+    "stride,pad,dil,groups", [(1, 1, 1, 1), (2, 0, 1, 1), (1, 2, 2, 1), (1, 1, 1, 2)]
+)
+def test_conv2d_matches_torch(stride, pad, dil, groups):
+    rng = np.random.RandomState(0)
+    x = rng.randn(2, 4, 9, 9).astype(np.float32)
+    w = rng.randn(6, 4 // groups, 3, 3).astype(np.float32)
+    b = rng.randn(6).astype(np.float32)
+
+    xv = pt.layers.data("x", shape=[4, 9, 9])
+    out = pt.layers.conv2d(
+        xv, num_filters=6, filter_size=3, stride=stride, padding=pad,
+        dilation=dil, groups=groups,
+        param_attr=pt.ParamAttr(name="cw"), bias_attr=pt.ParamAttr(name="cb"),
+    )
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    pt.global_scope().set("cw", w)
+    pt.global_scope().set("cb", b)
+    (got,) = exe.run(feed={"x": x}, fetch_list=[out])
+
+    want = F.conv2d(
+        torch.tensor(x), torch.tensor(w), torch.tensor(b),
+        stride=stride, padding=pad, dilation=dil, groups=groups,
+    ).numpy()
+    np.testing.assert_allclose(got, want, atol=1e-4)
+
+
+def test_conv2d_transpose_matches_torch():
+    rng = np.random.RandomState(1)
+    x = rng.randn(2, 4, 5, 5).astype(np.float32)
+    w = rng.randn(4, 3, 3, 3).astype(np.float32)  # [in_c, out_c, kh, kw]
+
+    xv = pt.layers.data("x", shape=[4, 5, 5])
+    out = pt.layers.conv2d_transpose(
+        xv, num_filters=3, filter_size=3, stride=2, padding=1,
+        param_attr=pt.ParamAttr(name="tw"),
+    )
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    pt.global_scope().set("tw", w)
+    (got,) = exe.run(feed={"x": x}, fetch_list=[out])
+
+    want = F.conv_transpose2d(
+        torch.tensor(x), torch.tensor(w), stride=2, padding=1
+    ).numpy()
+    np.testing.assert_allclose(got, want, atol=1e-4)
+
+
+def test_conv2d_transpose_bias_act_and_grads_match_torch():
+    """Nonzero bias + relu forward, and input/weight gradients of the
+
+    fractionally-strided formulation."""
+    rng = np.random.RandomState(5)
+    x = rng.randn(2, 4, 5, 5).astype(np.float32)
+    w = rng.randn(4, 3, 3, 3).astype(np.float32)
+    b = rng.randn(3).astype(np.float32)
+
+    xv = pt.layers.data("x", shape=[4, 5, 5])
+    out = pt.layers.conv2d_transpose(
+        xv, num_filters=3, filter_size=3, stride=2, padding=1,
+        param_attr=pt.ParamAttr(name="tw2"),
+        bias_attr=pt.ParamAttr(name="tb2"), act="relu",
+    )
+    loss = pt.layers.mean(pt.layers.elementwise_mul(out, out))
+    pt.append_backward(loss)
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    pt.global_scope().set("tw2", w)
+    pt.global_scope().set("tb2", b)
+    from paddle_tpu.core.program import grad_var_name
+
+    got, gw = exe.run(
+        feed={"x": x}, fetch_list=[out, grad_var_name("tw2")]
+    )
+
+    xt = torch.tensor(x)
+    wt = torch.tensor(w, requires_grad=True)
+    bt = torch.tensor(b, requires_grad=True)
+    yt = torch.relu(
+        F.conv_transpose2d(xt, wt, bt, stride=2, padding=1)
+    )
+    np.testing.assert_allclose(got, yt.detach().numpy(), atol=1e-4)
+    (yt * yt).mean().backward()
+    np.testing.assert_allclose(gw, wt.grad.numpy(), atol=1e-4)
+
+
+@pytest.mark.parametrize("ptype", ["max", "avg"])
+def test_pool2d_matches_torch(ptype):
+    rng = np.random.RandomState(2)
+    x = rng.randn(2, 3, 8, 8).astype(np.float32)
+    xv = pt.layers.data("x", shape=[3, 8, 8])
+    out = pt.layers.pool2d(xv, pool_size=3, pool_type=ptype, pool_stride=2,
+                           pool_padding=1)
+    (got,) = _run(None, {"x": x}, [out])
+    t = torch.tensor(x)
+    if ptype == "max":
+        want = F.max_pool2d(t, 3, stride=2, padding=1).numpy()
+    else:
+        want = F.avg_pool2d(t, 3, stride=2, padding=1,
+                            count_include_pad=False).numpy()
+    np.testing.assert_allclose(got, want, atol=1e-5)
+
+
+def test_batch_norm_matches_torch():
+    rng = np.random.RandomState(3)
+    x = rng.randn(4, 5, 6, 6).astype(np.float32)
+    xv = pt.layers.data("x", shape=[5, 6, 6])
+    out = pt.layers.batch_norm(xv, momentum=0.9)
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    (got,) = exe.run(feed={"x": x}, fetch_list=[out])
+
+    bn = torch.nn.BatchNorm2d(5, momentum=0.1, eps=1e-5)  # torch momentum = 1-ours
+    bn.train()
+    want = bn(torch.tensor(x)).detach().numpy()
+    np.testing.assert_allclose(got, want, atol=1e-4)
+    # running stats updated like torch's (new = 0.9*old + 0.1*batch)
+    prog = pt.default_main_program()
+    mean_name = [
+        op.inputs["Mean"][0] for b in prog.blocks for op in b.ops
+        if op.type == "batch_norm"
+    ][0]
+    got_mean = np.asarray(pt.global_scope().get(mean_name))
+    np.testing.assert_allclose(
+        got_mean, bn.running_mean.numpy(), atol=1e-4)
+
+
+def test_conv2d_gradients_match_torch():
+    """Input and weight gradients of conv via the framework autodiff."""
+    rng = np.random.RandomState(4)
+    x = rng.randn(2, 3, 7, 7).astype(np.float32)
+    w = rng.randn(4, 3, 3, 3).astype(np.float32)
+
+    xv = pt.layers.data("x", shape=[3, 7, 7])
+    out = pt.layers.conv2d(xv, num_filters=4, filter_size=3,
+                           param_attr=pt.ParamAttr(name="gw"),
+                           bias_attr=False)
+    loss = pt.layers.mean(pt.layers.elementwise_mul(out, out))
+    pt.append_backward(loss)
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    pt.global_scope().set("gw", w)
+    from paddle_tpu.core.program import grad_var_name
+
+    (gw,) = exe.run(feed={"x": x}, fetch_list=[grad_var_name("gw")])
+
+    xt = torch.tensor(x)
+    wt = torch.tensor(w, requires_grad=True)
+    yt = F.conv2d(xt, wt)
+    (yt * yt).mean().backward()
+    np.testing.assert_allclose(gw, wt.grad.numpy(), atol=1e-4)
