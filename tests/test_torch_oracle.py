@@ -14,9 +14,11 @@ import torch.nn.functional as F
 import paddle_tpu as pt
 
 
-def _run(build, feeds, fetch):
+def _run(feeds, fetch, params=None):
     exe = pt.Executor()
     exe.run(pt.default_startup_program())
+    for name, value in (params or {}).items():
+        pt.global_scope().set(name, value)
     return exe.run(feed=feeds, fetch_list=fetch)
 
 
@@ -114,7 +116,7 @@ def test_pool2d_matches_torch(ptype):
     xv = pt.layers.data("x", shape=[3, 8, 8])
     out = pt.layers.pool2d(xv, pool_size=3, pool_type=ptype, pool_stride=2,
                            pool_padding=1)
-    (got,) = _run(None, {"x": x}, [out])
+    (got,) = _run({"x": x}, [out])
     t = torch.tensor(x)
     if ptype == "max":
         want = F.max_pool2d(t, 3, stride=2, padding=1).numpy()
@@ -137,15 +139,22 @@ def test_batch_norm_matches_torch():
     bn.train()
     want = bn(torch.tensor(x)).detach().numpy()
     np.testing.assert_allclose(got, want, atol=1e-4)
-    # running stats updated like torch's (new = 0.9*old + 0.1*batch)
+    # running mean matches torch exactly (new = 0.9*old + 0.1*batch).
+    # running VAR intentionally differs: the reference (and this kernel)
+    # accumulate the BIASED batch variance while torch uses unbiased —
+    # assert with the tolerance that difference implies (factor n/(n-1))
     prog = pt.default_main_program()
-    mean_name = [
-        op.inputs["Mean"][0] for b in prog.blocks for op in b.ops
-        if op.type == "batch_norm"
-    ][0]
-    got_mean = np.asarray(pt.global_scope().get(mean_name))
+    bn_op = [op for b in prog.blocks for op in b.ops
+             if op.type == "batch_norm"][0]
+    got_mean = np.asarray(pt.global_scope().get(bn_op.inputs["Mean"][0]))
     np.testing.assert_allclose(
         got_mean, bn.running_mean.numpy(), atol=1e-4)
+    got_var = np.asarray(pt.global_scope().get(bn_op.inputs["Variance"][0]))
+    n = x.shape[0] * x.shape[2] * x.shape[3]
+    biased_running = 0.9 * 1.0 + 0.1 * (
+        bn.running_var.numpy() * 10 - 9.0  # invert torch's update
+    ) * (n - 1) / n
+    np.testing.assert_allclose(got_var, biased_running, atol=1e-4)
 
 
 def test_conv2d_gradients_match_torch():
