@@ -39,5 +39,6 @@ from .mesh import DP, MP, PP, SP, batch_sharded, dim_sharded, make_mesh, replica
 from .ring_attention import (  # noqa: F401
     ring_attention,
     scaled_dot_product_attention,
+    ulysses_attention,
 )
 from .sharded_embedding import sharded_embedding  # noqa: F401

@@ -14,6 +14,9 @@ Public API:
 - `ring_attention(q, k, v, mesh, axis=SP, causal=...)` — same math, with
   the T axis sharded over `axis`; runs under shard_map, differentiable
   (grads ride the reverse ring automatically via ppermute's transpose).
+- `ulysses_attention(...)` — all-to-all alternative: swaps the sequence
+  sharding for a head sharding (needs H divisible by the axis size),
+  runs full-sequence attention per head group, swaps back.
 
 Sharding contract: q/k/v are [B, T, H, D] with T divisible by the axis
 size; outputs keep the same sharding as q.
@@ -94,6 +97,53 @@ def _ring_attention_shard(q, k, v, axis_name: str, causal: bool):
     # in practice, but keep the division safe)
     l = jnp.maximum(l, 1e-30)
     return o / jnp.transpose(l, (0, 2, 1))[..., None]
+
+
+def _ulysses_shard(q, k, v, axis_name: str, causal: bool):
+    """Per-shard body: all-to-all swaps the T-sharding for an H-sharding,
+
+    each device then runs FULL-sequence attention for its H/n heads, and
+    the inverse all-to-all restores sequence sharding. One big all-to-all
+    in, one out — cheaper than the ring when heads are plentiful and the
+    interconnect is all-to-all capable (DeepSpeed-Ulysses scheme)."""
+    # [B, Tl, H, D] -> [B, T, H/n, D]
+    q, k, v = (
+        jax.lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                           tiled=True)
+        for x in (q, k, v)
+    )
+    o = scaled_dot_product_attention(q, k, v, causal=causal)
+    # [B, T, H/n, D] -> [B, Tl, H, D]
+    return jax.lax.all_to_all(o, axis_name, split_axis=1, concat_axis=2,
+                              tiled=True)
+
+
+def ulysses_attention(
+    q,
+    k,
+    v,
+    mesh: Mesh,
+    axis: str = SP,
+    causal: bool = False,
+):
+    """All-to-all sequence parallelism (Ulysses): requires the head count
+
+    divisible by the axis size; same sharding contract as ring_attention."""
+    if q.ndim != 4:
+        raise ValueError(f"expected [B, T, H, D], got {q.shape}")
+    n = mesh.shape[axis]
+    if q.shape[1] % n:
+        raise ValueError(f"T={q.shape[1]} not divisible by {axis}={n}")
+    if q.shape[2] % n:
+        raise ValueError(f"H={q.shape[2]} not divisible by {axis}={n}")
+    spec = PartitionSpec(None, axis, None, None)
+    fn = jax.shard_map(
+        functools.partial(_ulysses_shard, axis_name=axis, causal=causal),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+    )
+    return fn(q, k, v)
 
 
 def ring_attention(

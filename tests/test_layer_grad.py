@@ -12,13 +12,16 @@ import pytest
 import paddle_tpu as pt
 from paddle_tpu.core.lod import LoDArray
 
-RNG = np.random.RandomState(0)
+def _rng():
+    # fresh stream per case: inputs must not depend on test-run order
+    return np.random.RandomState(0)
 
 
 def _feed_dense(name, shape, dtype=np.float32, scale=0.5):
+    rng = _rng()
     if np.issubdtype(np.dtype(dtype), np.integer):
-        return {name: RNG.randint(0, 4, shape).astype(dtype)}
-    return {name: (RNG.randn(*shape) * scale).astype(dtype)}
+        return {name: rng.randint(0, 4, shape).astype(dtype)}
+    return {name: (rng.randn(*shape) * scale).astype(dtype)}
 
 
 def _scalarize(v):
@@ -74,8 +77,8 @@ def build_embedding_pool():
     pooled = pt.layers.sequence_pool(emb, "average")
     return _scalarize(pooled), {
         "ids": LoDArray.from_sequences(
-            [RNG.randint(0, 12, (3,)).astype(np.int32),
-             RNG.randint(0, 12, (5,)).astype(np.int32)], bucket=16)
+            [_rng().randint(0, 12, (3,)).astype(np.int32),
+             _rng().randint(0, 12, (5,)).astype(np.int32)], bucket=16)
     }
 
 
@@ -87,8 +90,8 @@ def build_lstm():
     last = pt.layers.sequence_last_step(h)
     return _scalarize(last), {
         "x": LoDArray.from_sequences(
-            [RNG.randn(4, 16).astype(np.float32) * 0.3,
-             RNG.randn(2, 16).astype(np.float32) * 0.3], bucket=16)
+            [_rng().randn(4, 16).astype(np.float32) * 0.3,
+             _rng().randn(2, 16).astype(np.float32) * 0.3], bucket=16)
     }
 
 
@@ -99,7 +102,7 @@ def build_gru():
     h = pt.layers.dynamic_gru(x, size=4, max_len=8)
     return _scalarize(pt.layers.sequence_pool(h, "sum")), {
         "x": LoDArray.from_sequences(
-            [RNG.randn(3, 12).astype(np.float32) * 0.3], bucket=8)
+            [_rng().randn(3, 12).astype(np.float32) * 0.3], bucket=8)
     }
 
 
@@ -110,8 +113,8 @@ def build_sequence_conv():
     h = pt.layers.sequence_conv(x, num_filters=4, filter_size=3)
     return _scalarize(pt.layers.sequence_pool(h, "max")), {
         "x": LoDArray.from_sequences(
-            [RNG.randn(5, 5).astype(np.float32) * 0.5,
-             RNG.randn(2, 5).astype(np.float32) * 0.5], bucket=16)
+            [_rng().randn(5, 5).astype(np.float32) * 0.5,
+             _rng().randn(2, 5).astype(np.float32) * 0.5], bucket=16)
     }
 
 
@@ -120,8 +123,7 @@ def build_nce_style_heads():
     x = pt.layers.data("x", shape=[7])
     h = pt.layers.fc(x, size=6, act="relu")
     a = pt.layers.fc(h, size=3)
-    b = pt.layers.bilinear_tensor_product(h, h, size=2) \
-        if hasattr(pt.layers, "bilinear_tensor_product") else a
+    b = pt.layers.bilinear_tensor_product(h, h, size=2)
     return _scalarize(pt.layers.concat([a, b], axis=1)), _feed_dense("x", (3, 7))
 
 
@@ -140,8 +142,8 @@ def build_recurrent_group():
     out = rnn()
     return _scalarize(pt.layers.sequence_pool(out, "sum")), {
         "x": LoDArray.from_sequences(
-            [RNG.randn(3, 4).astype(np.float32),
-             RNG.randn(2, 4).astype(np.float32)], bucket=8)
+            [_rng().randn(3, 4).astype(np.float32),
+             _rng().randn(2, 4).astype(np.float32)], bucket=8)
     }
 
 

@@ -76,3 +76,42 @@ def test_ring_under_jit_with_sharded_inputs(mesh_sp):
     want = scaled_dot_product_attention(q, k, v, causal=True)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5)
     assert got.sharding.spec[1] == pp.SP  # output stays sequence-sharded
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ulysses_matches_oracle(mesh_sp, causal):
+    from paddle_tpu.parallel.ring_attention import ulysses_attention
+
+    rng = np.random.RandomState(5)
+    # H must be divisible by the axis size (8)
+    q, k, v = (jnp.asarray(rng.randn(2, 16, 8, 4).astype(np.float32) * 0.5)
+               for _ in range(3))
+    want = scaled_dot_product_attention(q, k, v, causal=causal)
+    got = ulysses_attention(q, k, v, mesh_sp, causal=causal)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5)
+
+
+def test_ulysses_gradients_match_oracle(mesh_sp):
+    from paddle_tpu.parallel.ring_attention import ulysses_attention
+
+    rng = np.random.RandomState(6)
+    q, k, v = (jnp.asarray(rng.randn(2, 16, 8, 4).astype(np.float32) * 0.5)
+               for _ in range(3))
+
+    g_u = jax.grad(lambda q, k, v: jnp.sum(
+        ulysses_attention(q, k, v, mesh_sp, causal=True) ** 2),
+        argnums=(0, 1, 2))(q, k, v)
+    g_r = jax.grad(lambda q, k, v: jnp.sum(
+        scaled_dot_product_attention(q, k, v, causal=True) ** 2),
+        argnums=(0, 1, 2))(q, k, v)
+    for gu, gr in zip(g_u, g_r):
+        np.testing.assert_allclose(np.asarray(gu), np.asarray(gr), atol=5e-4)
+
+
+def test_ulysses_requires_divisible_heads(mesh_sp):
+    from paddle_tpu.parallel.ring_attention import ulysses_attention
+
+    rng = np.random.RandomState(7)
+    q = jnp.asarray(rng.randn(2, 16, 6, 4).astype(np.float32))
+    with pytest.raises(ValueError, match="H=6 not divisible"):
+        ulysses_attention(q, q, q, mesh_sp)

@@ -14,11 +14,9 @@ import torch.nn.functional as F
 import paddle_tpu as pt
 
 
-def _run(feeds, fetch, params=None):
+def _run(feeds, fetch):
     exe = pt.Executor()
     exe.run(pt.default_startup_program())
-    for name, value in (params or {}).items():
-        pt.global_scope().set(name, value)
     return exe.run(feed=feeds, fetch_list=fetch)
 
 
