@@ -73,17 +73,22 @@ def init(seed: int = 0, distributed: bool = False, **flag_overrides):
     """Reference API: `paddle.init(use_gpu=..., trainer_count=...)`
 
     (python/paddle/v2/__init__.py init — kwargs became gflags). Here:
-    kwargs set registry flags (unknown names raise, atomically — nothing
-    is applied if any name is unknown), `seed` seeds FLAGS.seed and the
+    kwargs set registry flags atomically — nothing is applied if any name
+    is unknown or any value fails coercion; `seed` seeds FLAGS.seed and the
     default programs, `distributed=True` runs jax.distributed
     initialization for multi-host (the etcd-membership parity)."""
-    from .flags import _REGISTRY
+    from .flags import _REGISTRY, _coerce
 
     unknown = [k for k in flag_overrides if k not in _REGISTRY]
     if unknown:
         raise AttributeError(f"undefined flags {unknown}")
-    for k, v in flag_overrides.items():
-        setattr(FLAGS, k, v)
+    # pre-coerce everything so a bad value leaves no partial application
+    coerced = {
+        k: _coerce(v, _REGISTRY[k]["default"])
+        for k, v in flag_overrides.items()
+    }
+    for k, v in coerced.items():
+        setattr(FLAGS, k, v)  # idempotent: v is already coerced
     if seed:
         FLAGS.seed = seed
         default_main_program().random_seed = seed

@@ -48,18 +48,50 @@ def _cmd_train(argv) -> int:
 
     from .trainer import CheckpointConfig, Trainer
 
+    train_opts = ("config", "num_passes", "save_dir")
     cfg = {}
     rest = []
     i = 0
     while i < len(argv):
         a = argv[i]
-        if a in ("--config", "--num_passes", "--save_dir") and i + 1 < len(argv):
-            cfg[a[2:]] = argv[i + 1]
-            i += 2
+        name, eq, val = a.partition("=") if a.startswith("--") else ("", "", "")
+        name = name[2:].replace("-", "_")  # same normalization as parse_flags
+        if name in train_opts:
+            # both '--config x' and '--config=x' forms; must be consumed
+            # BEFORE parse_flags (save_dir is also a registry flag and
+            # would otherwise be swallowed there, silently disabling the
+            # checkpoint dir)
+            if eq:
+                cfg[name] = val
+                i += 1
+            elif i + 1 < len(argv) and not argv[i + 1].startswith("--"):
+                cfg[name] = argv[i + 1]
+                i += 2
+            else:
+                raise SystemExit(f"flag --{name} requires a value")
         else:
             rest.append(a)
             i += 1
-    parse_flags(rest)
+    try:
+        leftover = parse_flags(rest)
+    except ValueError as e:
+        raise SystemExit(str(e)) from None
+    bad = [a for a in leftover if a.startswith("--")]
+    if bad:
+        # gflags parity: the reference errors on unknown flags rather than
+        # silently training with defaults (a typo'd --log_perod=10 must
+        # not be ignored). A known flag lands here too when its value is
+        # missing — tell those two cases apart.
+        from .flags import _REGISTRY
+
+        msgs = []
+        for a in bad:
+            fname = a[2:].split("=", 1)[0].replace("-", "_")
+            if fname in _REGISTRY:
+                msgs.append(f"flag --{fname} requires a value")
+            else:
+                msgs.append(f"unknown flag: {a}")
+        raise SystemExit("\n".join(msgs) + f"\n{flags_help()}")
     if "config" not in cfg:
         raise SystemExit("train requires --config <model.py>")
     model = _load_config(cfg["config"])

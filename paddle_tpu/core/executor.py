@@ -240,7 +240,9 @@ class Executor:
             program.version,
             program.amp_dtype,
             program.remat_policy,
-            FLAGS.use_fused_rnn,  # trace-affecting flag
+            # trace-affecting flags (both feed pallas_kernels dispatch)
+            FLAGS.use_fused_rnn,
+            FLAGS.fused_rnn_interpret,
             _feed_signature(feed),
             tuple(fetch_names),
             tuple(persist_names),

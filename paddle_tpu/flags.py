@@ -63,7 +63,7 @@ def parse_flags(argv: Optional[List[str]] = None) -> List[str]:
             name, val = a[2:].split("=", 1)
             name = name.replace("-", "_")
             if name in _REGISTRY:
-                setattr(FLAGS, name, val)
+                _set_parsed(name, val)
             else:
                 rest.append(a)
             i += 1
@@ -74,15 +74,28 @@ def parse_flags(argv: Optional[List[str]] = None) -> List[str]:
                 # gflags semantics: a bare boolean flag means True; never
                 # consume the next token as its value
                 setattr(FLAGS, name, True)
-            elif i + 1 < len(argv):
-                setattr(FLAGS, name, argv[i + 1])
+            elif i + 1 < len(argv) and not argv[i + 1].startswith("--"):
+                _set_parsed(name, argv[i + 1])
                 i += 1
             else:
+                # no value available (end of argv, or the next token is
+                # itself a flag) — leave it for the caller to reject
                 rest.append(a)
         else:
             rest.append(a)
         i += 1
     return rest
+
+
+def _set_parsed(name: str, val: str) -> None:
+    """setattr with a flag-parse error message instead of a bare
+    coercion ValueError."""
+    try:
+        setattr(FLAGS, name, val)
+    except (TypeError, ValueError) as e:
+        raise ValueError(
+            f"invalid value {val!r} for flag --{name}: {e}"
+        ) from None
 
 
 def flags_help() -> str:

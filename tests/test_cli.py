@@ -68,6 +68,44 @@ def test_cli_unknown_command(tmp_path):
     assert r.returncode != 0
 
 
+def test_cli_train_rejects_unknown_flag(tmp_path):
+    """gflags parity: a typo'd flag must error, not silently train with
+    defaults."""
+    cfg = tmp_path / "model.py"
+    cfg.write_text(CONFIG)
+    r = _run(["train", "--config", str(cfg), "--log_perod=10"],
+             str(tmp_path))
+    assert r.returncode != 0
+    assert "unknown flag" in (r.stderr + r.stdout)
+    assert "log_perod" in (r.stderr + r.stdout)
+
+
+def test_cli_train_eq_form_options(tmp_path):
+    """--num_passes=N / --save_dir=D forms must work (and save_dir must
+    reach the checkpoint config, not be swallowed by the flag registry)."""
+    cfg = tmp_path / "model.py"
+    cfg.write_text(CONFIG)
+    ckpt = tmp_path / "ck"
+    r = _run(["train", f"--config={cfg}", "--num_passes=2",
+              f"--save_dir={ckpt}"], str(tmp_path))
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "Pass 1 done" in r.stdout and "Pass 2 done" not in r.stdout
+    assert ckpt.exists()  # checkpoints actually written
+
+
+def test_cli_train_flag_missing_value_and_bad_value(tmp_path):
+    cfg = tmp_path / "model.py"
+    cfg.write_text(CONFIG)
+    r = _run(["train", "--config", str(cfg), "--beam_size"], str(tmp_path))
+    assert r.returncode != 0
+    assert "requires a value" in (r.stderr + r.stdout)
+    r = _run(["train", "--config", str(cfg), "--beam_size=abc"],
+             str(tmp_path))
+    assert r.returncode != 0
+    out = r.stderr + r.stdout
+    assert "invalid value" in out and "Traceback" not in out
+
+
 INFER_CONFIG = CONFIG + """
 
 def get_inference():

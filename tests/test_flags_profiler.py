@@ -39,6 +39,33 @@ def test_parse_bool_flag_bare():
     assert FLAGS.test_int_pf == 5 and rest == ["x"]
 
 
+def test_parse_never_consumes_flag_as_value():
+    """--int_flag --other: the next token is itself a flag, so it must not
+    be eaten as the value (and no bare-ValueError crash)."""
+    define_flag("test_int_nv", 2)
+    define_flag("test_bool_nv", False)
+    rest = parse_flags(["--test_int_nv", "--test_bool_nv"])
+    assert FLAGS.test_int_nv == 2  # unvalued: left alone
+    assert FLAGS.test_bool_nv is True
+    assert rest == ["--test_int_nv"]
+
+
+def test_parse_bad_value_names_flag():
+    define_flag("test_int_bv", 2)
+    with pytest.raises(ValueError, match="test_int_bv"):
+        parse_flags(["--test_int_bv=notanint"])
+    with pytest.raises(ValueError, match="test_int_bv"):
+        parse_flags(["--test_int_bv", "notanint"])
+
+
+def test_init_atomic_on_bad_value():
+    """A failing coercion mid-kwargs applies nothing (docstring claim)."""
+    before = FLAGS.log_period
+    with pytest.raises((TypeError, ValueError)):
+        pt.init(log_period=99, beam_size="xyz")  # int("xyz") fails
+    assert FLAGS.log_period == before
+
+
 def test_stat_timers():
     ss = profiler.StatSet()
     for _ in range(3):
