@@ -1,17 +1,25 @@
-"""Automatic mixed precision (bf16 compute, f32 master weights).
+"""Automatic mixed precision (bf16 compute AND activations, f32 masters).
 
 TPU analogue of the reference's half-precision support
 (paddle/math/float16.h:70 and the fp16 GEMM paths in paddle/cuda): on the
 MXU the fast matmul/conv datatype is bfloat16, which — unlike fp16 — keeps
 fp32's exponent range, so no loss scaling is needed.
 
-Design: parameters, optimizer state, and reductions stay float32; only the
-*inputs* to MXU ops (mul/matmul/conv*) are cast to the amp dtype, with
-float32 accumulation (`preferred_element_type`). Enabled per-Program via
-`Program.set_amp("bfloat16")` after building it, or the `pt.amp_guard()`
-context around the *run* calls; the executor reads the setting at run time
-and threads it into the traced env under `@AMP@`, where kernels pick it up
-via `cast_inputs`.
+Design (v5e roofline-driven — see PERF.md): parameters, optimizer state,
+batch-norm statistics and losses stay float32; MXU op *inputs* are cast to
+the amp dtype AND their outputs stay in the amp dtype, so activations flow
+through the network at 2 bytes/element. ResNet-scale models are
+HBM-bandwidth-bound on TPU, so halving activation traffic — not the MXU
+math itself — is most of AMP's win; casting each op's result back to f32
+(the previous design) forfeited it. Where f32 masters meet bf16 activations
+in an elementwise op (bias adds), the f32 side casts DOWN (`harmonize`),
+overriding numpy's promote-to-f32 rule. Numerically-sensitive kernels
+(batch_norm stats, softmax/log, losses) upcast internally and emit f32.
+
+Enabled per-Program via `Program.set_amp("bfloat16")` after building it, or
+the `pt.amp_guard()` context around the *run* calls; the executor reads the
+setting at run time and threads it into the traced env under `@AMP@`,
+where kernels pick it up via `cast_inputs`/`harmonize`.
 """
 
 from __future__ import annotations
@@ -36,6 +44,24 @@ def cast_inputs(ctx, *arrays):
             a = a.astype(dtype)
         out.append(a)
     return out[0] if len(out) == 1 else tuple(out)
+
+
+def harmonize(ctx, x, y):
+    """AMP meeting rule for binary elementwise ops: when an f32 array (a
+    master-weight bias/scale) meets an amp-dtype activation, cast the f32
+    side DOWN instead of numpy-promoting the activation up — otherwise one
+    bias add re-materializes the whole activation at 4 bytes/element."""
+    dtype = ctx.env.get(AMP_KEY)
+    if dtype is None:
+        return x, y
+    amp_dt = jnp.dtype(dtype)
+    dx = getattr(x, "dtype", None)
+    dy = getattr(y, "dtype", None)
+    if dx == amp_dt and dy == jnp.float32:
+        y = y.astype(amp_dt)
+    elif dy == amp_dt and dx == jnp.float32:
+        x = x.astype(amp_dt)
+    return x, y
 
 
 @contextlib.contextmanager

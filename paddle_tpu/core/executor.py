@@ -157,10 +157,21 @@ class _BlockRunner:
         param_names = list(op.attrs["params"])
         entry_counter = entry_env.get("@RNG_COUNTER@", 0)
 
-        def closure(pvals: Dict[str, Any]):
+        # params marked sparse_update get SelectedRows grads: their lookup
+        # sites route through a SparseGradTape so no dense [vocab, dim]
+        # gradient is ever materialized (framework/selected_rows.h parity)
+        sparse_names = [
+            p for p in param_names
+            if getattr(self._var_or_none(block, p), "sparse_update", False)
+        ]
+        dense_names = [p for p in param_names if p not in sparse_names]
+
+        def run_fwd(pvals: Dict[str, Any], tape):
             env2 = dict(entry_env)
             env2.update(pvals)
             env2["@RNG_COUNTER@"] = entry_counter
+            if tape is not None:
+                env2["@SPARSE_TAPE@"] = tape
             self.run_ops(fwd_ops, env2, dict(entry_env), block)
             loss = env2[loss_name]
             if getattr(loss, "size", 1) != 1:
@@ -170,17 +181,90 @@ class _BlockRunner:
                 )
             return jnp.reshape(loss, ())
 
-        pvals = {p: env[p] for p in param_names}
         policy = getattr(self.program, "remat_policy", None)
-        if policy:
-            # memory_optimization_transpiler parity: the reference reuses
-            # forward activations' memory via liveness analysis
-            # (fluid memory_optimization_transpiler.py); on TPU the same
-            # HBM↔FLOPs trade is jax.checkpoint over the loss closure
-            closure = jax.checkpoint(closure, policy=_REMAT_POLICIES[policy])
-        grads = jax.grad(closure)(pvals)
-        for p in param_names:
+        remat = (
+            (lambda f: jax.checkpoint(f, policy=_REMAT_POLICIES[policy]))
+            if policy else (lambda f: f)
+        )
+        pvals = {p: env[p] for p in dense_names}
+
+        if not sparse_names:
+            closure = remat(lambda pv: run_fwd(pv, None))
+            grads = jax.grad(closure)(pvals)
+            for p in dense_names:
+                env[grad_var_name(p)] = grads[p]
+            return
+
+        from .sparse import SelectedRows, SparseGradTape
+
+        # a sparse_update param may ONLY be consumed by lookup_table ops:
+        # any other use (e.g. a tied-embedding output projection through
+        # mul) would silently contribute zero gradient, because the param
+        # is stop_gradient'ed at lookup sites and excluded from the
+        # differentiated inputs. Static walk over every block catches it.
+        sparse_set = set(sparse_names)
+        for blk in self.program.blocks:
+            for o in blk.ops:
+                # optimizer update ops legitimately consume the param and
+                # its SelectedRows grad (ops/optimizer_ops.py handles both)
+                if o.type in ("lookup_table", "autodiff") or \
+                        o.attrs.get("is_optimizer_op"):
+                    continue
+                used = [n for ns in o.inputs.values() for n in ns
+                        if n in sparse_set]
+                if used:
+                    raise ValueError(
+                        f"sparse_update param(s) {used} consumed by op "
+                        f"{o.type!r}: SelectedRows gradients only support "
+                        "lookup_table uses — rebuild the embedding with "
+                        "is_sparse=False for tied/shared-weight patterns"
+                    )
+
+        # pass 1 (abstract, no FLOPs): discover gather sites and shapes
+        disco = SparseGradTape(sparse_names)
+        jax.eval_shape(lambda pv: run_fwd(pv, disco), pvals)
+        missing = [p for p in sparse_names
+                   if p not in {s[0] for s in disco.sites}]
+        if missing:
+            raise ValueError(
+                f"sparse_update params {missing} have no lookup_table site "
+                "in the program — only embedding gathers support "
+                "SelectedRows gradients"
+            )
+
+        # pass 2: differentiate w.r.t. dense params AND the per-site row
+        # slots; the slot cotangents are the SelectedRows values
+        def closure(pv, slots):
+            tape = SparseGradTape(sparse_names, slots=list(slots))
+            loss = run_fwd(pv, tape)
+            rows_aux = [r for (_, r) in tape.ids_out]
+            return loss, rows_aux
+
+        slots0 = [jnp.zeros(shape, dt) for (_, shape, dt) in disco.sites]
+        grad_fn = jax.value_and_grad(
+            remat(closure), argnums=(0, 1), has_aux=True
+        )
+        (_, rows_aux), (grads, slot_grads) = grad_fn(pvals, slots0)
+        for p in dense_names:
             env[grad_var_name(p)] = grads[p]
+        site_params = [s[0] for s in disco.sites]
+        for p in sparse_names:
+            num_rows = env[p].shape[0]
+            dim = env[p].shape[1]
+            rows = [r.reshape(-1) for sp, r in zip(site_params, rows_aux)
+                    if sp == p]
+            vals = [g.reshape(-1, dim)
+                    for sp, g in zip(site_params, slot_grads) if sp == p]
+            env[grad_var_name(p)] = SelectedRows(
+                jnp.concatenate(rows), jnp.concatenate(vals), num_rows
+            )
+
+    @staticmethod
+    def _var_or_none(block, name):
+        try:
+            return block.var(name)
+        except KeyError:
+            return None
 
 
 class Executor:

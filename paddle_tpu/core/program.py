@@ -54,6 +54,15 @@ class Variable:
     initializer: Any = None  # callable (rng, shape, dtype) -> np/jnp array
     op: Optional["Operator"] = None  # producer op
     stop_gradient: bool = False
+    # sparse feed slot (reference: SparseBinaryScanner/SparseFloatScanner,
+    # py_paddle/dataprovider_converter.py:154,184): "binary" | "float".
+    # Runtime value is a core/sparse.py SparseArray.
+    sparse_format: Optional[str] = None
+    # parameter receives SelectedRows (row-wise) gradients instead of a
+    # dense grad (reference: framework/selected_rows.h; embedding
+    # is_sparse=True). Set by layers.embedding; consumed by the autodiff
+    # lowering (core/executor.py) and optimizer ops.
+    sparse_update: bool = False
 
     # regularization / clipping attributes (set by ParamAttr)
     regularizer: Any = None
@@ -243,7 +252,7 @@ class Program:
     # -- serialization (model_format parity) --------------------------------
     def to_dict(self) -> dict:
         def var_d(v: Variable):
-            return {
+            d = {
                 "name": v.name,
                 "shape": list(v.shape),
                 "dtype": np.dtype(v.dtype).name,
@@ -251,6 +260,14 @@ class Program:
                 "persistable": v.persistable,
                 "is_parameter": v.is_parameter,
             }
+            # sparse semantics must survive the round-trip: a restored
+            # program silently losing sparse_update would densify the
+            # embedding gradient; losing sparse_format would break feeding
+            if v.sparse_update:
+                d["sparse_update"] = True
+            if v.sparse_format:
+                d["sparse_format"] = v.sparse_format
+            return d
 
         return {
             "version": 1,
@@ -291,6 +308,8 @@ class Program:
                     lod_level=vd["lod_level"],
                     persistable=vd["persistable"],
                     is_parameter=vd["is_parameter"],
+                    sparse_update=vd.get("sparse_update", False),
+                    sparse_format=vd.get("sparse_format"),
                 )
             for od in bd["ops"]:
                 b.ops.append(Operator(od["type"], od["inputs"], od["outputs"], od["attrs"]))

@@ -16,6 +16,7 @@ import numpy as np
 
 from ..core.lod import LoDArray
 from ..core.program import Variable
+from ..core.sparse import SparseArray
 
 
 class DataFeeder:
@@ -30,7 +31,16 @@ class DataFeeder:
         out = {}
         for slot_idx, var in enumerate(self.feed_list):
             vals = [sample[slot_idx] for sample in batch]
-            if var.lod_level == 0:
+            if getattr(var, "sparse_format", None):
+                # sparse_binary/sparse_float slots (SparseBinaryScanner /
+                # SparseFloatScanner parity): each sample is a list of
+                # active indices, or of (index, value) pairs
+                dim = int(var.shape[-1])
+                out[var.name] = SparseArray.from_batch(
+                    vals, dim=dim, format=var.sparse_format,
+                    bucket=self.bucket, dtype=np.dtype(var.dtype),
+                )
+            elif var.lod_level == 0:
                 arr = np.asarray(vals, dtype=np.dtype(var.dtype))
                 want = tuple(d for d in var.shape if d != -1)
                 if arr.ndim == 1 and want:

@@ -123,14 +123,15 @@ define_flag("save_dir", "./output",
 define_flag("enable_timers", False,
             "accumulate REGISTER_TIMER-style stat timers "
             "(reference: utils/Stat.h, WITH_TIMER)")
-define_flag("use_fused_rnn", False,
+define_flag("use_fused_rnn", True,
             "use pallas fused LSTM/GRU sequence kernels when shapes are "
             "eligible and the backend is TPU (reference: "
             "hl_lstm_parallel_forward fused CUDA kernels, "
-            "cuda/include/hl_lstm.h:42). Off by default: measured on "
-            "v5e at T=100 B=128 H=512, XLA's lax.scan lowering is ~7% "
-            "faster forward and comparable backward; flip on for shapes "
-            "where the fused kernel wins")
+            "cuda/include/hl_lstm.h:42). On by default: measured on v5e "
+            "the fused train recurrence beats lax.scan 1.1-1.5x across "
+            "T/B/H/dtype (benchmarks/lstm_kernel_microbench.json; round-1's "
+            "contrary measurement was an artifact of the tunnel's d2h "
+            "readback latency, see PERF.md)")
 define_flag("fused_rnn_interpret", False,
             "testing only: allow the fused RNN kernels in pallas interpret "
             "mode on non-TPU backends")

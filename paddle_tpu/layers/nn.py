@@ -72,13 +72,21 @@ def data(
     dtype=np.float32,
     lod_level: int = 0,
     append_batch_size: bool = True,
+    sparse_format: Optional[str] = None,
 ) -> Variable:
     """Reference: fluid layers/io.py `data` — declares a feed variable.
 
-    shape excludes the batch dim when append_batch_size=True."""
+    shape excludes the batch dim when append_batch_size=True.
+    sparse_format="binary"/"float" declares a sparse slot (reference v2
+    data_type.sparse_binary_vector / sparse_float_vector backed by
+    CpuSparseMatrix); the runtime value is a core/sparse.py SparseArray
+    and shape must be [dim]."""
     block = default_main_program().current_block()
     full_shape = ((-1,) + tuple(shape)) if append_batch_size else tuple(shape)
-    return block.create_var(name, full_shape, dtype, lod_level=lod_level)
+    if sparse_format not in (None, "binary", "float"):
+        raise ValueError(f"sparse_format must be 'binary'/'float', got {sparse_format!r}")
+    return block.create_var(name, full_shape, dtype, lod_level=lod_level,
+                            sparse_format=sparse_format)
 
 
 def fc(
@@ -142,8 +150,11 @@ def embedding(
 ) -> Variable:
     """Reference: fluid layers/nn.py:184 `embedding` / lookup_table_op.cc.
 
-    is_sparse is accepted for API parity; XLA lowers the gather grad to
-    scatter-add which is the same thing SelectedRows bought the reference."""
+    is_sparse=True gives the table SelectedRows (row-wise) gradients
+    (reference: framework/selected_rows.h + SparseRowMatrix.h): the autodiff
+    lowering never materializes a dense [vocab, dim] grad — it takes grads
+    w.r.t. the gathered rows only (core/executor.py) — and optimizer ops
+    apply lazy row-wise updates via scatter (ops/optimizer_ops.py)."""
     helper = LayerHelper("embedding", name=name)
     w = helper.create_parameter(
         param_attr,
@@ -151,6 +162,8 @@ def embedding(
         dtype=dtype,
         default_initializer=NormalInitializer(0.0, 0.01),
     )
+    if is_sparse:
+        w.sparse_update = True
     out = helper.create_tmp_variable(dtype, input.shape + (size[1],), input.lod_level)
     helper.append_op(
         type="lookup_table",
@@ -190,10 +203,15 @@ def conv2d(
     param_attr=None,
     bias_attr=None,
     name=None,
+    data_format: str = "NCHW",
 ) -> Variable:
-    """Reference: fluid layers/nn.py:772 `conv2d`; Gen-1 img_conv_layer."""
+    """Reference: fluid layers/nn.py:772 `conv2d`; Gen-1 img_conv_layer.
+
+    data_format="NHWC" runs channels-minor — the TPU-native layout (channel
+    dim lands on the 128-wide lane register dimension; no relayout before
+    the MXU). The weight parameter keeps OIHW shape either way."""
     helper = LayerHelper("conv2d", name=name)
-    in_c = input.shape[1]
+    in_c = input.shape[1] if data_format == "NCHW" else input.shape[3]
     fh, fw = _pair_(filter_size)
     w_shape = (num_filters, in_c // groups, fh, fw)
     fan_in = (in_c // groups) * fh * fw
@@ -205,10 +223,11 @@ def conv2d(
     if bias_attr is not False:
         b = helper.create_parameter(bias_attr, (num_filters,), is_bias=True)
         inputs["Bias"] = [b]
-    out = helper.create_tmp_variable(
-        input.dtype,
-        (-1, num_filters) + _conv_out_hw(input.shape[2:4], (fh, fw), stride, padding, dilation),
-    )
+    hw_in = input.shape[2:4] if data_format == "NCHW" else input.shape[1:3]
+    out_hw = _conv_out_hw(hw_in, (fh, fw), stride, padding, dilation)
+    out_shape = ((-1, num_filters) + out_hw if data_format == "NCHW"
+                 else (-1,) + out_hw + (num_filters,))
+    out = helper.create_tmp_variable(input.dtype, out_shape)
     helper.append_op(
         type="conv2d",
         inputs=inputs,
@@ -218,6 +237,7 @@ def conv2d(
             "paddings": padding,
             "dilations": dilation,
             "groups": groups,
+            "data_format": data_format,
         },
     )
     return helper.append_activation(out, act)
@@ -260,19 +280,24 @@ def pool2d(
     global_pooling: bool = False,
     exclusive: bool = True,
     name=None,
+    data_format: str = "NCHW",
 ) -> Variable:
     """Reference: fluid layers/nn.py `pool2d` / pool_op.cc."""
     helper = LayerHelper("pool2d", name=name)
+    hw_in = input.shape[2:4] if data_format == "NCHW" else input.shape[1:3]
+    c = input.shape[1] if data_format == "NCHW" else input.shape[3]
     if global_pooling:
         out_hw = (1, 1)
     else:
         out_hw = _conv_out_hw(
-            input.shape[2:4],
+            hw_in,
             pool_size,
             pool_stride if pool_stride is not None else pool_size,
             pool_padding,
         )
-    out = helper.create_tmp_variable(input.dtype, (-1, input.shape[1]) + out_hw)
+    out_shape = ((-1, c) + out_hw if data_format == "NCHW"
+                 else (-1,) + out_hw + (c,))
+    out = helper.create_tmp_variable(input.dtype, out_shape)
     helper.append_op(
         type="pool2d",
         inputs={"X": [input]},
@@ -284,6 +309,7 @@ def pool2d(
             "paddings": pool_padding,
             "global_pooling": global_pooling,
             "exclusive": exclusive,
+            "data_format": data_format,
         },
     )
     return out
@@ -298,10 +324,11 @@ def batch_norm(
     param_attr=None,
     bias_attr=None,
     name=None,
+    data_format: str = "NCHW",
 ) -> Variable:
     """Reference: fluid layers/nn.py `batch_norm` / batch_norm_op.cc."""
     helper = LayerHelper("batch_norm", name=name)
-    c = input.shape[1]
+    c = input.shape[1] if data_format == "NCHW" else input.shape[-1]
     scale = helper.create_parameter(
         param_attr, (c,), default_initializer=ConstantInitializer(1.0)
     )
@@ -329,7 +356,8 @@ def batch_norm(
         inputs={"X": [input], "Scale": [scale], "Bias": [bias],
                 "Mean": [mean], "Variance": [var]},
         outputs={"Y": [out]},
-        attrs={"momentum": momentum, "epsilon": epsilon, "is_test": is_test},
+        attrs={"momentum": momentum, "epsilon": epsilon, "is_test": is_test,
+               "data_format": data_format},
     )
     return helper.append_activation(out, act)
 

@@ -14,51 +14,65 @@ import paddle_tpu.layers as layers
 
 # ----------------------------------------------------------------- ResNet --
 def conv_bn_layer(input, num_filters, filter_size, stride=1, padding=None,
-                  act="relu", is_test=False):
+                  act="relu", is_test=False, data_format="NCHW"):
     if padding is None:
         padding = (filter_size - 1) // 2
     conv = layers.conv2d(
         input, num_filters=num_filters, filter_size=filter_size,
         stride=stride, padding=padding, bias_attr=False,
+        data_format=data_format,
     )
-    return layers.batch_norm(conv, act=act, is_test=is_test)
+    return layers.batch_norm(conv, act=act, is_test=is_test,
+                             data_format=data_format)
 
 
-def _shortcut(input, ch_out, stride, is_test):
-    ch_in = input.shape[1]
+def _shortcut(input, ch_out, stride, is_test, data_format="NCHW"):
+    ch_in = input.shape[1] if data_format == "NCHW" else input.shape[-1]
     if ch_in != ch_out or stride != 1:
-        return conv_bn_layer(input, ch_out, 1, stride, 0, act=None, is_test=is_test)
+        return conv_bn_layer(input, ch_out, 1, stride, 0, act=None,
+                             is_test=is_test, data_format=data_format)
     return input
 
 
-def _bottleneck(input, ch_out, stride, is_test):
-    short = _shortcut(input, ch_out * 4, stride, is_test)
-    conv1 = conv_bn_layer(input, ch_out, 1, 1, 0, is_test=is_test)
-    conv2 = conv_bn_layer(conv1, ch_out, 3, stride, 1, is_test=is_test)
-    conv3 = conv_bn_layer(conv2, ch_out * 4, 1, 1, 0, act=None, is_test=is_test)
+def _bottleneck(input, ch_out, stride, is_test, data_format="NCHW"):
+    short = _shortcut(input, ch_out * 4, stride, is_test, data_format)
+    conv1 = conv_bn_layer(input, ch_out, 1, 1, 0, is_test=is_test,
+                          data_format=data_format)
+    conv2 = conv_bn_layer(conv1, ch_out, 3, stride, 1, is_test=is_test,
+                          data_format=data_format)
+    conv3 = conv_bn_layer(conv2, ch_out * 4, 1, 1, 0, act=None,
+                          is_test=is_test, data_format=data_format)
     return layers.relu(layers.elementwise_add(conv3, short))
 
 
-def _basicblock(input, ch_out, stride, is_test):
-    short = _shortcut(input, ch_out, stride, is_test)
-    conv1 = conv_bn_layer(input, ch_out, 3, stride, 1, is_test=is_test)
-    conv2 = conv_bn_layer(conv1, ch_out, 3, 1, 1, act=None, is_test=is_test)
+def _basicblock(input, ch_out, stride, is_test, data_format="NCHW"):
+    short = _shortcut(input, ch_out, stride, is_test, data_format)
+    conv1 = conv_bn_layer(input, ch_out, 3, stride, 1, is_test=is_test,
+                          data_format=data_format)
+    conv2 = conv_bn_layer(conv1, ch_out, 3, 1, 1, act=None, is_test=is_test,
+                          data_format=data_format)
     return layers.relu(layers.elementwise_add(conv2, short))
 
 
-def resnet_imagenet(input, class_dim=1000, depth=50, is_test=False):
+def resnet_imagenet(input, class_dim=1000, depth=50, is_test=False,
+                    data_format="NCHW"):
     """ResNet-50/101/152 (reference: benchmark/paddle/image/resnet.py
 
-    layout; bottleneck counts per the standard table)."""
+    layout; bottleneck counts per the standard table). data_format="NHWC"
+    runs channels-minor — the TPU-preferred layout (input must then be
+    [H, W, C])."""
     cfg = {50: [3, 4, 6, 3], 101: [3, 4, 23, 3], 152: [3, 8, 36, 3]}[depth]
-    conv = conv_bn_layer(input, 64, 7, 2, 3, is_test=is_test)
-    pool = layers.pool2d(conv, pool_size=3, pool_stride=2, pool_padding=1)
+    conv = conv_bn_layer(input, 64, 7, 2, 3, is_test=is_test,
+                         data_format=data_format)
+    pool = layers.pool2d(conv, pool_size=3, pool_stride=2, pool_padding=1,
+                         data_format=data_format)
     ch = [64, 128, 256, 512]
     for stage, count in enumerate(cfg):
         for i in range(count):
             stride = 2 if i == 0 and stage > 0 else 1
-            pool = _bottleneck(pool, ch[stage], stride, is_test)
-    pool = layers.pool2d(pool, pool_type="avg", global_pooling=True)
+            pool = _bottleneck(pool, ch[stage], stride, is_test, data_format)
+    pool = layers.pool2d(pool, pool_type="avg", global_pooling=True,
+                         data_format=data_format)
     return layers.fc(pool, size=class_dim)
 
 

@@ -76,9 +76,17 @@ def attention_gru_decoder_kernel(ctx):
     trg_len = ctx.attr("trg_max_len") or trg_l.capacity
     enc_b, enc_mask = enc_l.to_batch(max_len=src_len, time_major=False)  # [B,S,C]
     trg_b, trg_mask = trg_l.to_batch(max_len=trg_len)  # [T,B,E]
+    # uniform compute dtype under amp: f32 master params cast down to the
+    # activation dtype so the scan carry dtype is stable (see rnn_ops)
+    dt = trg_b.dtype
+    wa_enc, wa_dec, v_att = (w.astype(dt) for w in (wa_enc, wa_dec, v_att))
+    wx, wh = wx.astype(dt), wh.astype(dt)
+    bias = None if bias is None else bias.astype(dt)
+    h0 = h0.astype(dt)
+    enc_b = enc_b.astype(dt)
     enc_proj = jnp.dot(
         enc_b, wa_enc, preferred_element_type=jnp.float32
-    ).astype(enc_b.dtype)  # [B, S, A]
+    ).astype(dt)  # [B, S, A]
 
     def step(h_prev, inp):
         x_t, m_t = inp  # [B, E], [B]
@@ -126,6 +134,12 @@ def attention_gru_beam_search_kernel(ctx):
     norm_by_len = ctx.attr("length_normalize", False)
 
     enc_b, enc_mask = enc_l.to_batch(max_len=src_len, time_major=False)
+    dt = enc_b.dtype  # uniform dtype under amp (see attention_gru_decoder)
+    wa_enc, wa_dec, v_att = (w.astype(dt) for w in (wa_enc, wa_dec, v_att))
+    wx, wh = wx.astype(dt), wh.astype(dt)
+    bias = None if bias is None else bias.astype(dt)
+    emb, w_out, b_out = emb.astype(dt), w_out.astype(dt), b_out.astype(dt)
+    h0 = h0.astype(dt)
     enc_proj = jnp.dot(
         enc_b, wa_enc, preferred_element_type=jnp.float32
     ).astype(enc_b.dtype)

@@ -20,6 +20,7 @@ import numpy as np
 from .. import amp
 from ..core.lod import LoDArray
 from ..core.registry import register_op
+from ..core.sparse import SparseArray
 
 
 def _data(x):
@@ -38,6 +39,14 @@ def mul_kernel(ctx):
     x_num_col_dims then GEMM (math/math_function matmul → cuBLAS; here MXU).
     """
     x_in = ctx.input("X")
+    if isinstance(x_in, SparseArray):
+        # sparse × dense (reference: CpuSparseMatrix::mul, sparse input
+        # slots feeding an FC): gather + weighted segment-sum — never
+        # densifies the [N, dim] input; output stays at the compute dtype
+        # (bf16 under amp, like every other MXU kernel)
+        w = amp.cast_inputs(ctx, ctx.input("Y"))
+        ctx.set_output("Out", x_in.matmul(w))
+        return
     x, y = _data(x_in), _data(ctx.input("Y"))
     xd = ctx.attr("x_num_col_dims", 1)
     yd = ctx.attr("y_num_col_dims", 1)
@@ -45,12 +54,14 @@ def mul_kernel(ctx):
     x2 = x.reshape((int(np.prod(xs[:xd])), -1)) if x.ndim > 2 or xd != 1 else x
     y2 = y.reshape((int(np.prod(ys[:yd])), -1)) if y.ndim > 2 or yd != 1 else y
     x2, y2 = amp.cast_inputs(ctx, x2, y2)
-    out = jnp.dot(x2, y2, preferred_element_type=jnp.float32)
+    # f32 MXU accumulation; the result is then stored at the compute dtype
+    # (bf16 under amp — activations stay 2 B/elem, see amp.py)
+    out = jnp.dot(x2, y2, preferred_element_type=jnp.float32).astype(x2.dtype)
     # restore leading dims: out shape is xs[:xd] + ys[yd:] (mul_op.cc InferShape)
     out_shape = tuple(xs[:xd]) + tuple(ys[yd:])
     if out.shape != out_shape:
         out = out.reshape(out_shape)
-    ctx.set_output("Out", _like(x_in, out.astype(x.dtype)))
+    ctx.set_output("Out", _like(x_in, out))
 
 
 @register_op("matmul")
@@ -63,9 +74,8 @@ def matmul_kernel(ctx):
         x = jnp.swapaxes(x, -1, -2)
     if ctx.attr("transpose_Y", False):
         y = jnp.swapaxes(y, -1, -2)
-    dtype = x.dtype
     x, y = amp.cast_inputs(ctx, x, y)
-    out = jnp.matmul(x, y, preferred_element_type=jnp.float32).astype(dtype)
+    out = jnp.matmul(x, y, preferred_element_type=jnp.float32).astype(x.dtype)
     ctx.set_output("Out", out)
 
 
@@ -87,6 +97,9 @@ def _make_elementwise(name, fn):
         x, y = ctx.input("X"), ctx.input("Y")
         xd, yd = _data(x), _data(y)
         yd = _broadcast_y(xd, yd, ctx.attr("axis", -1))
+        # under amp, f32 masters (biases/scales) cast DOWN to meet bf16
+        # activations instead of promoting the activation up (amp.py)
+        xd, yd = amp.harmonize(ctx, xd, yd)
         ctx.set_output("Out", _like(x, fn(xd, yd)))
 
     register_op(name)(kernel)
@@ -104,7 +117,12 @@ _make_elementwise("elementwise_pow", jnp.power)
 # ------------------------------------------------------------- reductions --
 @register_op("mean")
 def mean_kernel(ctx):
-    ctx.set_output("Out", jnp.mean(_data(ctx.input("X"))))
+    x = _data(ctx.input("X"))
+    # loss-style reduction: accumulate + emit f32 for any reduced-precision
+    # float input (bf16/f16 — amp or not)
+    if jnp.issubdtype(x.dtype, jnp.floating) and x.dtype != jnp.float32:
+        x = x.astype(jnp.float32)
+    ctx.set_output("Out", jnp.mean(x))
 
 
 @register_op("sum")
@@ -257,15 +275,28 @@ def top_k_kernel(ctx):
 def lookup_table_kernel(ctx):
     """Reference: paddle/operators/lookup_table_op.cc — embedding gather.
 
-    Sparse SelectedRows grads (is_sparse=True) are unnecessary here: jax
-    computes dense grads but XLA lowers gather-grad to scatter-add, and the
-    sharded path lives in parallel/sharded_embedding.py."""
+    When the table is marked sparse_update (embedding is_sparse=True) and an
+    autodiff trace is active, the gather routes through the SparseGradTape
+    so the table's gradient stays SelectedRows (rows+values), never dense —
+    framework/selected_rows.h parity, see core/sparse.py."""
     w = ctx.input("W")
     ids = ctx.input("Ids")
     ids_data = _data(ids)
     if ids_data.ndim > 1 and ids_data.shape[-1] == 1:
         ids_data = ids_data[..., 0]
-    out = jnp.take(w, ids_data, axis=0)
+    tape = ctx.env.get("@SPARSE_TAPE@")
+    wname = ctx.op.inputs["W"][0]
+    if tape is not None and tape.wants(wname):
+        gathered = jnp.take(jax.lax.stop_gradient(w), ids_data, axis=0)
+        out = gathered + tape.next_slot(gathered)
+        rows = ids_data.astype(jnp.int32)
+        if isinstance(ids, LoDArray):
+            # padding tokens must not touch row 0: point them out of range
+            # so the row-wise optimizer update drops them
+            rows = jnp.where(ids.seq_ids >= 0, rows, w.shape[0])
+        tape.record_site(wname, rows)
+    else:
+        out = jnp.take(w, ids_data, axis=0)
     if ctx.attr("padding_idx") is not None:
         pad = ctx.attr("padding_idx")
         out = jnp.where((ids_data == pad)[..., None], 0.0, out)

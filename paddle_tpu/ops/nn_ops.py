@@ -32,16 +32,25 @@ def conv2d_kernel(ctx):
     """Reference: paddle/operators/conv_op.cc (REGISTER_OP conv2d);
 
     groups/dilation semantics per ConvOp::InferShape."""
-    x = ctx.input("Input")  # [N, C, H, W]
-    w = ctx.input("Filter")  # [out_c, in_c/groups, kh, kw]
+    x = ctx.input("Input")  # [N, C, H, W] (or NHWC per data_format)
+    w = ctx.input("Filter")  # [out_c, in_c/groups, kh, kw] always OIHW
     stride = _pair(ctx.attr("strides", (1, 1)))
     pad = _pair(ctx.attr("paddings", (0, 0)))
     dil = _pair(ctx.attr("dilations", (1, 1)))
     groups = ctx.attr("groups", 1)
-    dtype = x.dtype
+    # NHWC: channels-minor is the TPU-preferred layout (channel dim maps
+    # to the 128-wide lane dimension without a relayout); the parameter
+    # keeps the reference's OIHW shape for checkpoint compatibility and is
+    # transposed at trace time (weights are small; XLA folds this)
+    fmt = ctx.attr("data_format", "NCHW")
+    if fmt == "NHWC":
+        w = jnp.transpose(w, (2, 3, 1, 0))  # OIHW -> HWIO
     xc, wc = amp.cast_inputs(ctx, x, w)
-    # under amp the conv runs bf16→bf16 (MXU accumulates f32 internally);
-    # a mixed preferred_element_type would break conv's VJP transpose rule
+    # under amp the conv runs bf16→bf16 and the OUTPUT stays bf16 (the MXU
+    # accumulates f32 internally; keeping the activation at 2 B/elem is the
+    # HBM-traffic win — see amp.py). A mixed preferred_element_type would
+    # break conv's VJP transpose rule, so f32 accumulation is only
+    # requested on the pure-f32 path.
     acc = jnp.float32 if xc.dtype == jnp.float32 else None
     out = jax.lax.conv_general_dilated(
         xc,
@@ -50,11 +59,15 @@ def conv2d_kernel(ctx):
         padding=[(pad[0], pad[0]), (pad[1], pad[1])],
         rhs_dilation=dil,
         feature_group_count=groups,
-        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        dimension_numbers=(
+            (fmt, "OIHW" if fmt == "NCHW" else "HWIO", fmt)
+        ),
         preferred_element_type=acc,
-    ).astype(dtype)
+    )
     if ctx.has_input("Bias"):
-        out = out + ctx.input("Bias").reshape((1, -1, 1, 1))
+        bshape = (1, -1, 1, 1) if fmt == "NCHW" else (1, 1, 1, -1)
+        bias = ctx.input("Bias").reshape(bshape)
+        out = out + bias.astype(out.dtype)
     ctx.set_output("Output", out)
 
 
@@ -71,7 +84,6 @@ def conv2d_transpose_kernel(ctx):
     pad = _pair(ctx.attr("paddings", (0, 0)))
     kh, kw = w.shape[2], w.shape[3]
     wk = jnp.transpose(w, (1, 0, 2, 3))[:, :, ::-1, ::-1]  # OIHW, flipped
-    dtype = x.dtype
     xc, wc = amp.cast_inputs(ctx, x, wk)
     acc = jnp.float32 if xc.dtype == jnp.float32 else None
     out = jax.lax.conv_general_dilated(
@@ -83,9 +95,10 @@ def conv2d_transpose_kernel(ctx):
         lhs_dilation=stride,
         dimension_numbers=("NCHW", "OIHW", "NCHW"),
         preferred_element_type=acc,
-    ).astype(dtype)
+    )
     if ctx.has_input("Bias"):
-        out = out + ctx.input("Bias").reshape((1, -1, 1, 1))
+        bias = ctx.input("Bias").reshape((1, -1, 1, 1))
+        out = out + bias.astype(out.dtype)
     ctx.set_output("Output", out)
 
 
@@ -95,18 +108,26 @@ def pool2d_kernel(ctx):
     """Reference: paddle/operators/pool_op.cc — max/avg, ksize/strides/
 
     paddings, global_pooling."""
-    x = ctx.input("X")  # [N, C, H, W]
+    x = ctx.input("X")  # [N, C, H, W] (or NHWC per data_format)
     ptype = ctx.attr("pooling_type", "max")
     ksize = _pair(ctx.attr("ksize", (2, 2)))
     stride = _pair(ctx.attr("strides", (2, 2)))
     pad = _pair(ctx.attr("paddings", (0, 0)))
+    fmt = ctx.attr("data_format", "NCHW")
+    hw = slice(2, 4) if fmt == "NCHW" else slice(1, 3)
     if ctx.attr("global_pooling", False):
-        ksize = x.shape[2:4]
+        ksize = x.shape[hw]
         stride = ksize
         pad = (0, 0)
-    window = (1, 1) + ksize
-    strides = (1, 1) + stride
-    pads = ((0, 0), (0, 0), (pad[0], pad[0]), (pad[1], pad[1]))
+    sp_pad = ((pad[0], pad[0]), (pad[1], pad[1]))
+    if fmt == "NCHW":
+        window = (1, 1) + tuple(ksize)
+        strides = (1, 1) + tuple(stride)
+        pads = ((0, 0), (0, 0)) + sp_pad
+    else:
+        window = (1,) + tuple(ksize) + (1,)
+        strides = (1,) + tuple(stride) + (1,)
+        pads = ((0, 0),) + sp_pad + ((0, 0),)
     if ptype == "max":
         init = -jnp.inf
         out = jax.lax.reduce_window(x, init, jax.lax.max, window, strides, pads)
@@ -135,23 +156,27 @@ def batch_norm_kernel(ctx):
     eps = ctx.attr("epsilon", 1e-5)
     is_test = ctx.attr("is_test", False)
 
-    axes = tuple(i for i in range(x.ndim) if i != 1)
-    shape = (1, -1) + (1,) * (x.ndim - 2)
+    ch = x.ndim - 1 if ctx.attr("data_format", "NCHW") == "NHWC" else 1
+    axes = tuple(i for i in range(x.ndim) if i != ch)
+    shape = tuple(-1 if i == ch else 1 for i in range(x.ndim))
+    # stats in f32 even when activations are bf16 (amp): mean/var of a
+    # large batch loses precision in bf16; running stats stay f32 masters
+    x32 = x.astype(jnp.float32)
     if is_test:
         mean, var = mean_v, var_v
     else:
-        mean = jnp.mean(x, axis=axes)
-        var = jnp.var(x, axis=axes)
+        mean = jnp.mean(x32, axis=axes)
+        var = jnp.var(x32, axis=axes)
         new_mean = momentum * mean_v + (1 - momentum) * mean
         new_var = momentum * var_v + (1 - momentum) * var
         # running stats flow back into the Scope as persistables
         ctx.env[ctx.op.inputs["Mean"][0]] = new_mean
         ctx.env[ctx.op.inputs["Variance"][0]] = new_var
     inv = jax.lax.rsqrt(var + eps)
-    out = (x - mean.reshape(shape)) * inv.reshape(shape) * scale.reshape(
+    out = (x32 - mean.reshape(shape)) * inv.reshape(shape) * scale.reshape(
         shape
     ) + bias.reshape(shape)
-    ctx.set_output("Y", out)
+    ctx.set_output("Y", out.astype(x.dtype))
 
 
 @register_op("layer_norm")
@@ -161,14 +186,15 @@ def layer_norm_kernel(ctx):
     eps = ctx.attr("epsilon", 1e-5)
     begin = ctx.attr("begin_norm_axis", 1)
     axes = tuple(range(begin, x.ndim))
-    mean = jnp.mean(x, axis=axes, keepdims=True)
-    var = jnp.var(x, axis=axes, keepdims=True)
-    out = (x - mean) * jax.lax.rsqrt(var + eps)
+    x32 = x.astype(jnp.float32)  # stats in f32 under amp (see batch_norm)
+    mean = jnp.mean(x32, axis=axes, keepdims=True)
+    var = jnp.var(x32, axis=axes, keepdims=True)
+    out = (x32 - mean) * jax.lax.rsqrt(var + eps)
     if ctx.has_input("Scale"):
         out = out * ctx.input("Scale")
     if ctx.has_input("Bias"):
         out = out + ctx.input("Bias")
-    ctx.set_output("Y", out)
+    ctx.set_output("Y", out.astype(x.dtype))
 
 
 # --------------------------------------------------------------- dropout ---
@@ -221,7 +247,8 @@ def softmax_with_cross_entropy_kernel(ctx):
     ragged = isinstance(logits_in, LoDArray)
     logits = logits_in.data if ragged else logits_in
     label = label_in.data if isinstance(label_in, LoDArray) else label_in
-    logp = jax.nn.log_softmax(logits, axis=-1)
+    # softmax/log in f32 even under amp (loss numerics)
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
     if ctx.attr("soft_label", False):
         loss = -jnp.sum(label * logp, axis=-1, keepdims=True)
     else:

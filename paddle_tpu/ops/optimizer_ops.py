@@ -14,6 +14,7 @@ from __future__ import annotations
 import jax.numpy as jnp
 
 from ..core.registry import register_op
+from ..core.sparse import SelectedRows
 
 
 def _write(ctx, slot_in, value):
@@ -32,8 +33,15 @@ def _lr(ctx):
 
 @register_op("sgd")
 def sgd_kernel(ctx):
-    """Reference: sgd_op.cc — p -= lr * g."""
+    """Reference: sgd_op.cc — p -= lr * g. SelectedRows grads (embedding
+    is_sparse) apply as a row-wise scatter-add, touching only gathered rows
+    (sgd_op.cc's SelectedRows branch / SparseRowMatrix sgdUpdate)."""
     p, g = ctx.input("Param"), ctx.input("Grad")
+    if isinstance(g, SelectedRows):
+        # duplicate rows accumulate — scatter-add is linear, no dedup needed
+        _write(ctx, "Param",
+               p.at[g.rows].add(-_lr(ctx) * g.values, mode="drop"))
+        return
     _write(ctx, "Param", p - _lr(ctx) * g)
 
 
@@ -43,6 +51,17 @@ def momentum_kernel(ctx):
     p, g, v = ctx.input("Param"), ctx.input("Grad"), ctx.input("Velocity")
     mu = ctx.attr("mu", 0.9)
     lr = _lr(ctx)
+    if isinstance(g, SelectedRows):
+        # lazy momentum: decay + step only on touched rows
+        rows, vals = g.dedup()
+        v_rows = mu * v[rows] + vals
+        if ctx.attr("use_nesterov", False):
+            step = -(vals + mu * v_rows) * lr
+        else:
+            step = -lr * v_rows
+        _write(ctx, "Velocity", v.at[rows].set(v_rows, mode="drop"))
+        _write(ctx, "Param", p.at[rows].add(step, mode="drop"))
+        return
     v_new = mu * v + g
     if ctx.attr("use_nesterov", False):
         p_new = p - (g + mu * v_new) * lr
@@ -54,9 +73,20 @@ def momentum_kernel(ctx):
 
 @register_op("adagrad")
 def adagrad_kernel(ctx):
-    """Reference: adagrad_op.cc — moment += g²; p -= lr*g/(√moment+ε)."""
+    """Reference: adagrad_op.cc — moment += g²; p -= lr*g/(√moment+ε).
+
+    SelectedRows grads: lazy row-wise update (adagrad_op.cc SelectedRows
+    branch merges duplicate rows first — dedup() here; untouched rows'
+    moments stay untouched, matching the reference's sparse semantics)."""
     p, g, m = ctx.input("Param"), ctx.input("Grad"), ctx.input("Moment")
     eps = ctx.attr("epsilon", 1e-6)
+    if isinstance(g, SelectedRows):
+        rows, vals = g.dedup()
+        m_rows = m[rows] + jnp.square(vals)
+        upd = -_lr(ctx) * vals / (jnp.sqrt(m_rows) + eps)
+        _write(ctx, "Moment", m.at[rows].set(m_rows, mode="drop"))
+        _write(ctx, "Param", p.at[rows].add(upd, mode="drop"))
+        return
     m_new = m + jnp.square(g)
     p_new = p - _lr(ctx) * g / (jnp.sqrt(m_new) + eps)
     _write(ctx, "Moment", m_new)
@@ -116,6 +146,20 @@ def adam_kernel(ctx):
     b2 = ctx.attr("beta2", 0.999)
     eps = ctx.attr("epsilon", 1e-8)
     lr = _lr(ctx)
+    if isinstance(g, SelectedRows):
+        # lazy adam (adam_op.cc SelectedRows branch): moments and step only
+        # on touched rows; Beta*Pow still advance globally per step
+        rows, vals = g.dedup()
+        m1r = b1 * m1[rows] + (1 - b1) * vals
+        m2r = b2 * m2[rows] + (1 - b2) * jnp.square(vals)
+        lr_t = lr * jnp.sqrt(1 - b2p) / (1 - b1p)
+        step = -lr_t * m1r / (jnp.sqrt(m2r) + eps)
+        _write(ctx, "Moment1", m1.at[rows].set(m1r, mode="drop"))
+        _write(ctx, "Moment2", m2.at[rows].set(m2r, mode="drop"))
+        _write(ctx, "Beta1Pow", b1p * b1)
+        _write(ctx, "Beta2Pow", b2p * b2)
+        _write(ctx, "Param", p.at[rows].add(step, mode="drop"))
+        return
     m1n = b1 * m1 + (1 - b1) * g
     m2n = b2 * m2 + (1 - b2) * jnp.square(g)
     lr_t = lr * jnp.sqrt(1 - b2p) / (1 - b1p)

@@ -56,6 +56,10 @@ def lstm_supported(B: int, H: int, gate_act, cell_act, cand_act, peep) -> bool:
         and cand_act == "tanh"
         and B % 8 == 0
         and H % 128 == 0
+        # the backward kernel's f32 dW accumulator ([H, 4H] = 16H² bytes)
+        # must fit scoped VMEM (~16 MB) next to the weight + io blocks;
+        # H=640 → 6.6 MB accumulator, H=1024 would already exceed the cap
+        and H <= 640
         and _backend_ok()
     )
 
@@ -85,10 +89,13 @@ def _lstm_kernel(
         c_s[:] = jnp.zeros_like(c_s)
 
     h_prev = h_s[:]
-    c_prev = c_s[:]
-    gates = x_ref[0] + jnp.dot(
+    c_prev = c_s[:].astype(jnp.float32)
+    # gate math in f32 on the VPU regardless of io dtype (also works
+    # around Mosaic's refusal to broadcast an f32 scalar into a bf16
+    # vector inside sigmoid); the MXU matmul accumulates f32 anyway
+    gates = x_ref[0].astype(jnp.float32) + jnp.dot(
         h_prev, w_ref[:], preferred_element_type=jnp.float32
-    ).astype(x_ref.dtype)
+    )
     H = h_prev.shape[-1]
     i = jax.nn.sigmoid(gates[:, :H])
     f = jax.nn.sigmoid(gates[:, H : 2 * H])
@@ -96,18 +103,19 @@ def _lstm_kernel(
     o = jax.nn.sigmoid(gates[:, 3 * H :])
     c = f * c_prev + i * g
     h = o * jnp.tanh(c)
-    m = m_ref[0, 0].astype(h.dtype)[:, None]
-    h = m * h + (1 - m) * h_prev
+    m = m_ref[0, 0][:, None]
+    h = m * h + (1 - m) * h_prev.astype(jnp.float32)
     c = m * c + (1 - m) * c_prev
-    h_s[:] = h
-    c_s[:] = c
-    h_seq_ref[0] = h
-    c_seq_ref[0] = c
+    dt = h_s.dtype
+    h_s[:] = h.astype(dt)
+    c_s[:] = c.astype(dt)
+    h_seq_ref[0] = h.astype(dt)
+    c_seq_ref[0] = c.astype(dt)
 
     @pl.when(t == pl.num_programs(0) - 1)
     def _():
-        hT_ref[:] = h
-        cT_ref[:] = c
+        hT_ref[:] = h.astype(dt)
+        cT_ref[:] = c.astype(dt)
 
 
 def _lstm_pallas_raw(x_tbh, mask, w_rec):
@@ -172,21 +180,22 @@ def _lstm_bwd_kernel(
         dc_s[:] = dcT_ref[:]
         dw_s[:] = jnp.zeros_like(dw_s)
 
-    gates = gates_ref[0]
+    # all gate/cotangent math in f32 (see _lstm_kernel's dtype note)
+    gates = gates_ref[0].astype(jnp.float32)
     H = dh_s.shape[-1]
     i = jax.nn.sigmoid(gates[:, :H])
     f = jax.nn.sigmoid(gates[:, H : 2 * H])
     g = jnp.tanh(gates[:, 2 * H : 3 * H])
     o = jax.nn.sigmoid(gates[:, 3 * H :])
-    c_prev = cprev_ref[0]
+    c_prev = cprev_ref[0].astype(jnp.float32)
     h_prev = hprev_ref[0]
-    m = m_ref[0, 0].astype(gates.dtype)[:, None]
+    m = m_ref[0, 0][:, None]
 
     c_raw = f * c_prev + i * g
     tc = jnp.tanh(c_raw)
 
-    dh_total = dh_seq_ref[0] + dh_s[:]
-    dc_total = dc_s[:]
+    dh_total = dh_seq_ref[0].astype(jnp.float32) + dh_s[:].astype(jnp.float32)
+    dc_total = dc_s[:].astype(jnp.float32)
     dh_raw = m * dh_total
     dc_raw = m * dc_total + dh_raw * o * (1 - tc * tc)
     do_a = dh_raw * tc * o * (1 - o)
@@ -195,16 +204,18 @@ def _lstm_bwd_kernel(
     dg_a = dc_raw * i * (1 - g * g)
     dgates = jnp.concatenate([di_a, df_a, dg_a, do_a], axis=1)
 
-    dx_ref[0] = dgates
+    dt = dx_ref.dtype
+    dx_ref[0] = dgates.astype(dt)
     dh_s[:] = (
         jnp.dot(
-            dgates, w_ref[:].T, preferred_element_type=jnp.float32
-        ).astype(dgates.dtype)
+            dgates.astype(dt), w_ref[:].T,
+            preferred_element_type=jnp.float32,
+        )
         + (1 - m) * dh_total
-    )
-    dc_s[:] = dc_raw * f + (1 - m) * dc_total
+    ).astype(dh_s.dtype)
+    dc_s[:] = (dc_raw * f + (1 - m) * dc_total).astype(dc_s.dtype)
     dw_s[:] = dw_s[:] + jnp.dot(
-        h_prev.T, dgates, preferred_element_type=jnp.float32
+        h_prev.T, dgates.astype(dt), preferred_element_type=jnp.float32
     )
 
     @pl.when(s == pl.num_programs(0) - 1)
@@ -271,7 +282,13 @@ def lstm_fused(x_tbh, mask, w_rec, bias=None, reverse=False):
     Mirrors lstm_scan's signature subset: optional pre-gate bias and
     time reversal (flip in, flip the emitted sequence back)."""
     if bias is not None:
-        x_tbh = x_tbh + bias
+        # master-weight bias casts DOWN to the activation dtype (amp):
+        # promoting x to f32 here would double the whole sequence's HBM
+        # traffic through the kernel
+        x_tbh = x_tbh + bias.astype(x_tbh.dtype)
+    # f32 master weight likewise meets the activation dtype at the kernel
+    # boundary; the cast's transpose restores an f32 dW for the optimizer
+    w_rec = w_rec.astype(x_tbh.dtype)
     if reverse:
         h_seq, last = _lstm_fused_core(x_tbh[::-1], mask[::-1], w_rec)
         return h_seq[::-1], last
@@ -311,31 +328,31 @@ def _gru_kernel(x_ref, m_ref, w_ref, h_seq_ref, hT_ref, h_s):
 
     h_prev = h_s[:]
     H = h_prev.shape[-1]
-    xp = x_ref[0]
+    xp = x_ref[0].astype(jnp.float32)  # gate math in f32 (see _lstm_kernel)
     w_ur = w_ref[:, : 2 * H]
     w_c = w_ref[:, 2 * H :]
     ur = jax.nn.sigmoid(
         xp[:, : 2 * H]
-        + jnp.dot(h_prev, w_ur, preferred_element_type=jnp.float32).astype(
-            xp.dtype
-        )
+        + jnp.dot(h_prev, w_ur, preferred_element_type=jnp.float32)
     )
     u, r = ur[:, :H], ur[:, H:]
     c = jnp.tanh(
         xp[:, 2 * H :]
         + jnp.dot(
-            r * h_prev, w_c, preferred_element_type=jnp.float32
-        ).astype(xp.dtype)
+            (r * h_prev.astype(jnp.float32)).astype(h_prev.dtype), w_c,
+            preferred_element_type=jnp.float32,
+        )
     )
-    h = (1 - u) * h_prev + u * c
-    m = m_ref[0, 0].astype(h.dtype)[:, None]
-    h = m * h + (1 - m) * h_prev
-    h_s[:] = h
-    h_seq_ref[0] = h
+    h = (1 - u) * h_prev.astype(jnp.float32) + u * c
+    m = m_ref[0, 0][:, None]
+    h = m * h + (1 - m) * h_prev.astype(jnp.float32)
+    dt = h_s.dtype
+    h_s[:] = h.astype(dt)
+    h_seq_ref[0] = h.astype(dt)
 
     @pl.when(t == pl.num_programs(0) - 1)
     def _():
-        hT_ref[:] = h
+        hT_ref[:] = h.astype(dt)
 
 
 def _gru_pallas_raw(x_tbh, mask, w_rec):
@@ -366,7 +383,8 @@ def _gru_pallas_raw(x_tbh, mask, w_rec):
 def gru_fused(x_tbh, mask, w_rec, bias=None, reverse=False):
     """Fused GRU over the whole sequence (zero-boot, sigmoid/tanh)."""
     if bias is not None:
-        x_tbh = x_tbh + bias
+        x_tbh = x_tbh + bias.astype(x_tbh.dtype)  # see lstm_fused
+    w_rec = w_rec.astype(x_tbh.dtype)
     if reverse:
         h_seq, h_T = _gru_fused_core(x_tbh[::-1], mask[::-1], w_rec)
         return h_seq[::-1], h_T

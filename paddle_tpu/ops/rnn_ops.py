@@ -53,8 +53,16 @@ def lstm_scan(
     T, B, H4 = x_tbh.shape
     H = H4 // 4
     ga, ca, da = _act(gate_act), _act(cell_act), _act(cand_act)
-    h0 = jnp.zeros((B, H), x_tbh.dtype) if h0 is None else h0
-    c0 = jnp.zeros((B, H), x_tbh.dtype) if c0 is None else c0
+    # uniform compute dtype: under amp the projected input arrives bf16
+    # while weights/bias/boot-state are f32 masters — cast them down so
+    # the scan carry dtype is stable (bf16 keeps the recurrence HBM-light;
+    # the recurrent matmul still accumulates f32 on the MXU below)
+    dt = x_tbh.dtype
+    w_rec = w_rec.astype(dt)
+    bias = None if bias is None else bias.astype(dt)
+    w_peephole = None if w_peephole is None else w_peephole.astype(dt)
+    h0 = jnp.zeros((B, H), dt) if h0 is None else h0.astype(dt)
+    c0 = jnp.zeros((B, H), dt) if c0 is None else c0.astype(dt)
     if reverse:
         x_tbh = x_tbh[::-1]
         mask = mask[::-1]
@@ -129,7 +137,10 @@ def gru_scan(
     T, B, H3 = x_tbh.shape
     H = H3 // 3
     ga, da = _act(gate_act), _act(cand_act)
-    h0 = jnp.zeros((B, H), x_tbh.dtype) if h0 is None else h0
+    dt = x_tbh.dtype  # uniform carry dtype under amp (see lstm_scan)
+    w_rec = w_rec.astype(dt)
+    bias = None if bias is None else bias.astype(dt)
+    h0 = jnp.zeros((B, H), dt) if h0 is None else h0.astype(dt)
     if reverse:
         x_tbh = x_tbh[::-1]
         mask = mask[::-1]

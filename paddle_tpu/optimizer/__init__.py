@@ -289,26 +289,45 @@ class Optimizer:
         opt_pass_start = len(block.ops)
         params_grads = append_backward(loss, parameter_list, no_grad_set)
 
-        # regularization: grad += decay(param)  (fluid regularizer.py)
+        # regularization: grad += decay(param)  (fluid regularizer.py).
+        # sparse_update params skip it: decay over the whole table would
+        # densify the SelectedRows grad and defeat the row-wise update
+        # (the reference's sparse remote updater likewise applies no decay
+        # trainer-side — RemoteParameterUpdater.h:265)
         new_pg = []
         for p, g in params_grads:
             reg = p.regularizer or self.regularization
-            if reg is not None:
+            if reg is not None and not getattr(p, "sparse_update", False):
                 g = reg.append_decay(p, g)
             new_pg.append((p, g))
         params_grads = new_pg
 
-        # clipping (fluid clip.py; Gen-1 OptimizerWithGradientClipping)
+        # clipping (fluid clip.py; Gen-1 OptimizerWithGradientClipping).
+        # sparse_update grads pass through unclipped (same densification
+        # rationale as regularization above)
+        def _dense_pg():
+            return [pg for pg in params_grads
+                    if not getattr(pg[0], "sparse_update", False)]
+
+        def _sparse_pg():
+            return [pg for pg in params_grads
+                    if getattr(pg[0], "sparse_update", False)]
+
         if isinstance(self.grad_clip, GradientClipByGlobalNorm):
-            params_grads = self.grad_clip.apply_all(helper, params_grads)
+            params_grads = (
+                self.grad_clip.apply_all(helper, _dense_pg()) + _sparse_pg()
+            )
         elif self.grad_clip is not None:
             params_grads = [
-                (p, self.grad_clip.apply_one(helper, p, g)) for p, g in params_grads
+                (p, g) if getattr(p, "sparse_update", False)
+                else (p, self.grad_clip.apply_one(helper, p, g))
+                for p, g in params_grads
             ]
         else:
             pg2 = []
             for p, g in params_grads:
-                if p.grad_clip is not None:
+                if p.grad_clip is not None and \
+                        not getattr(p, "sparse_update", False):
                     if isinstance(p.grad_clip, GradientClipByGlobalNorm):
                         raise ValueError(
                             "per-param global-norm clip unsupported; set it on the optimizer"

@@ -13,11 +13,13 @@ trainer/checkpoint.py)."""
 
 from __future__ import annotations
 
+import logging
 import os
 from typing import Optional
 
 import jax
 
+logger = logging.getLogger("paddle_tpu.distributed")
 
 _initialized = False
 
@@ -27,7 +29,11 @@ def init_distributed(
     num_processes: Optional[int] = None,
     process_id: Optional[int] = None,
 ) -> None:
-    """Initialize multi-host JAX. No-op when single-host or already done.
+    """Initialize multi-host JAX. Single-host fallback is LOUD: a
+    misconfigured cluster job silently training on one host is the failure
+    mode the reference's etcd desired-count barrier existed to prevent
+    (go/pserver/etcd_client.go:31-41), so the fallback logs a warning with
+    the exact env vars that were missing.
 
     Args default from env (COORDINATOR_ADDRESS, NUM_PROCESSES, PROCESS_ID)
     the way the reference's trainer read trainer_id/pservers gflags."""
@@ -35,13 +41,33 @@ def init_distributed(
     if _initialized:
         return
     coordinator_address = coordinator_address or os.environ.get("COORDINATOR_ADDRESS")
+    num_processes = int(num_processes or os.environ.get("NUM_PROCESSES", 1))
     if coordinator_address is None:
-        _initialized = True  # single host
+        if num_processes > 1:
+            raise ValueError(
+                f"init_distributed: num_processes={num_processes} (arg or "
+                "NUM_PROCESSES env) but no coordinator_address — set "
+                "COORDINATOR_ADDRESS"
+            )
+        logger.warning(
+            "init_distributed: no COORDINATOR_ADDRESS — running SINGLE-HOST. "
+            "For multi-host, set COORDINATOR_ADDRESS=<host:port>, "
+            "NUM_PROCESSES and PROCESS_ID on every process."
+        )
+        _initialized = True
         return
+    process_id = int(
+        process_id if process_id is not None
+        else os.environ.get("PROCESS_ID", 0)
+    )
+    logger.info(
+        "init_distributed: joining %s as process %d/%d",
+        coordinator_address, process_id, num_processes,
+    )
     jax.distributed.initialize(
         coordinator_address=coordinator_address,
-        num_processes=int(num_processes or os.environ.get("NUM_PROCESSES", 1)),
-        process_id=int(process_id or os.environ.get("PROCESS_ID", 0)),
+        num_processes=num_processes,
+        process_id=process_id,
     )
     _initialized = True
 

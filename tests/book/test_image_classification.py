@@ -10,9 +10,27 @@ import numpy as np
 import pytest
 
 import paddle_tpu as pt
-from paddle_tpu.data import batch, shuffle
+from paddle_tpu.data import batch, map_readers, shuffle
+from paddle_tpu.data import image as pimg
 from paddle_tpu.data.datasets import cifar
 from paddle_tpu.models import resnet_cifar10, vgg
+
+
+_AUG_COUNTER = [0]
+
+
+def _augment(sample):
+    """Reference training augmentation (v2/image.py simple_transform):
+    resize short edge 36 → random 32-crop + mirror → CHW float.
+    Deterministic but per-sample-varying seed (a per-class seed would
+    freeze the transform for every image of that class)."""
+    im, label = sample
+    _AUG_COUNTER[0] += 1
+    hwc = np.asarray(im, np.float32).reshape(3, 32, 32).transpose(1, 2, 0)
+    out = pimg.simple_transform(hwc, resize_size=36, crop_size=32,
+                                is_train=True,
+                                rng=np.random.RandomState(_AUG_COUNTER[0]))
+    return out, label
 
 
 @pytest.mark.parametrize("net", ["resnet", "vgg"])
@@ -30,7 +48,10 @@ def test_image_classification_train(net):
     exe = pt.Executor()
     exe.run(pt.default_startup_program())
 
-    reader = batch(shuffle(cifar.train10(), 256, seed=0), 32, drop_last=True)
+    reader = batch(
+        map_readers(_augment, shuffle(cifar.train10(), 256, seed=0)),
+        32, drop_last=True,
+    )
     losses, accs = [], []
     max_steps = 25  # bound single-core CI runtime; convergence shows within this
     for _pass in range(3):

@@ -22,8 +22,11 @@ def _user_tower():
     gender = pt.layers.data("gender", shape=[1], dtype=np.int32)
     age = pt.layers.data("age", shape=[1], dtype=np.int32)
     job = pt.layers.data("job", shape=[1], dtype=np.int32)
+    # the big id tables use is_sparse=True: SelectedRows row-wise grads +
+    # lazy adam (reference book fixture also marks these IsSparse)
     feats = [
-        pt.layers.embedding(uid, size=[movielens.max_user_id() + 1, EMB]),
+        pt.layers.embedding(uid, size=[movielens.max_user_id() + 1, EMB],
+                            is_sparse=True),
         pt.layers.embedding(gender, size=[2, EMB // 2]),
         pt.layers.embedding(age, size=[len(movielens.age_table), EMB // 2]),
         pt.layers.embedding(job, size=[movielens.max_job_id() + 1, EMB // 2]),
@@ -38,14 +41,16 @@ def _movie_tower():
                           append_batch_size=False)
     title = pt.layers.data("title", shape=[-1], dtype=np.int32, lod_level=1,
                            append_batch_size=False)
-    mid_emb = pt.layers.embedding(mid, size=[movielens.max_movie_id() + 1, EMB])
+    mid_emb = pt.layers.embedding(mid, size=[movielens.max_movie_id() + 1, EMB],
+                                  is_sparse=True)
     mid_flat = pt.layers.reshape(mid_emb, (-1, EMB))
     cat_emb = pt.layers.embedding(
         cats, size=[len(movielens.movie_categories()), EMB // 2]
     )
     cat_pool = pt.layers.sequence_pool(cat_emb, "sum")
     title_emb = pt.layers.embedding(
-        title, size=[len(movielens.get_movie_title_dict()), EMB]
+        title, size=[len(movielens.get_movie_title_dict()), EMB],
+        is_sparse=True,
     )
     title_pool = pt.layers.sequence_pool(title_emb, "average")
     return pt.layers.fc(

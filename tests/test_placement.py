@@ -1,0 +1,98 @@
+"""Per-layer placement / tensor-parallel FC demo (ParallelNeuralNetwork
+parity).
+
+Reference: ParallelNeuralNetwork dispatches layers to devices from a
+per-layer `device` attr (gserver/gradientmachines/ParallelNeuralNetwork.h:34,
+proto/ModelConfig.proto:399). TPU-native: a Variable's `.sharding`
+PartitionSpec places that layer's weight over a mesh axis; GSPMD inserts
+the collectives. Here a wide FC pair runs Megatron-style over `mp`
+(column-parallel W1, row-parallel W2 — the activation stays sharded
+between them and one psum materializes after W2), trained on the 8-device
+CPU mesh, asserted equal to the replicated run.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+from jax.sharding import PartitionSpec
+
+import paddle_tpu as pt
+from paddle_tpu import parallel as pp
+
+
+@pytest.fixture
+def mesh42():
+    assert len(jax.devices()) == 8, "conftest must force 8 virtual devices"
+    return pp.make_mesh((4, 2), ("dp", "mp"))
+
+
+def _build(shard_over=None):
+    """MLP with a wide hidden layer; shard_over="mp" marks W1
+    column-parallel and W2 row-parallel via Variable.sharding."""
+    x = pt.layers.data("x", shape=[16])
+    y = pt.layers.data("y", shape=[1])
+    h = pt.layers.fc(x, size=64, act="relu",
+                     param_attr=pt.ParamAttr(name="w1"), bias_attr=False)
+    pred = pt.layers.fc(h, size=1, param_attr=pt.ParamAttr(name="w2"),
+                        bias_attr=False)
+    loss = pt.layers.mean(pt.layers.square_error_cost(pred, y))
+    pt.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    if shard_over:
+        gb = pt.default_main_program().global_block()
+        # column-parallel: [in, hidden] split on the hidden (output) dim
+        gb.var("w1").sharding = PartitionSpec(None, shard_over)
+        # row-parallel: [hidden, out] split on the hidden (input) dim;
+        # GSPMD emits the mp psum after this matmul
+        gb.var("w2").sharding = PartitionSpec(shard_over, None)
+    return loss
+
+
+def _train(executor_factory, shard_over, steps=4):
+    pt.reset()
+    loss_var = _build(shard_over)
+    prog = pt.default_main_program()
+    prog.random_seed = 9
+    pt.default_startup_program().random_seed = 9
+    exe = executor_factory()
+    pt.Executor().run(pt.default_startup_program())
+    rng = np.random.RandomState(1)
+    xv = rng.randn(16, 16).astype(np.float32)
+    yv = rng.randn(16, 1).astype(np.float32)
+    losses = []
+    for _ in range(steps):
+        (l,) = exe.run(prog, feed={"x": xv, "y": yv}, fetch_list=[loss_var])
+        losses.append(float(l))
+    w1 = np.asarray(pt.global_scope().get("w1"))
+    w2 = np.asarray(pt.global_scope().get("w2"))
+    return losses, w1, w2
+
+
+def test_tensor_parallel_fc_matches_replicated(mesh42):
+    ls_rep, w1_rep, w2_rep = _train(pt.Executor, shard_over=None)
+    ls_tp, w1_tp, w2_tp = _train(
+        lambda: pp.ParallelExecutor(mesh42), shard_over="mp"
+    )
+    np.testing.assert_allclose(ls_tp, ls_rep, rtol=1e-4, atol=1e-6)
+    np.testing.assert_allclose(w1_tp, w1_rep, rtol=1e-4, atol=1e-6)
+    np.testing.assert_allclose(w2_tp, w2_rep, rtol=1e-4, atol=1e-6)
+
+
+def test_sharding_is_physically_applied(mesh42):
+    """The mp-sharded weight must actually live split across mesh devices
+    (not just numerically agree): check the committed sharding on device."""
+    pt.reset()
+    loss_var = _build(shard_over="mp")
+    prog = pt.default_main_program()
+    exe = pp.ParallelExecutor(mesh42)
+    pt.Executor().run(pt.default_startup_program())
+    rng = np.random.RandomState(1)
+    feed = {"x": rng.randn(8, 16).astype(np.float32),
+            "y": rng.randn(8, 1).astype(np.float32)}
+    exe.run(prog, feed=feed, fetch_list=[loss_var])
+    w1_dev = pt.global_scope().get("w1")
+    spec = w1_dev.sharding.spec
+    assert tuple(spec) == (None, "mp"), spec
+    # each device holds a [16, 32] column slice of the [16, 64] weight
+    shard_shapes = {s.data.shape for s in w1_dev.addressable_shards}
+    assert shard_shapes == {(16, 32)}, shard_shapes

@@ -1,0 +1,292 @@
+"""Sparse input slots + SelectedRows (row-wise) gradient tests.
+
+Reference parity targets:
+- paddle/py_paddle/dataprovider_converter.py:154,184 (SparseBinaryScanner /
+  SparseFloatScanner) — sparse feed slots.
+- paddle/math/CpuSparseMatrix.h — sparse x dense matmul semantics.
+- paddle/framework/selected_rows.h + lookup_table_op.cc (is_sparse) — rows+
+  values gradients with lazy optimizer updates.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.core.sparse import SelectedRows, SparseArray
+from paddle_tpu.data.feeder import DataFeeder
+
+
+# ------------------------------------------------------- SparseArray core --
+def test_sparse_array_binary_to_dense():
+    samples = [[0, 3], [2], [], [1, 3]]
+    sa = SparseArray.from_batch(samples, dim=4, format="binary", bucket=8)
+    dense = np.asarray(sa.to_dense())
+    want = np.zeros((4, 4), np.float32)
+    for r, idxs in enumerate(samples):
+        for i in idxs:
+            want[r, i] = 1.0
+    np.testing.assert_allclose(dense, want)
+
+
+def test_sparse_array_float_to_dense_and_matmul():
+    samples = [[(0, 0.5), (2, -1.5)], [(1, 2.0)]]
+    sa = SparseArray.from_batch(samples, dim=3, format="float", bucket=8)
+    dense = np.asarray(sa.to_dense())
+    want = np.array([[0.5, 0, -1.5], [0, 2.0, 0]], np.float32)
+    np.testing.assert_allclose(dense, want)
+    w = np.random.RandomState(0).randn(3, 5).astype(np.float32)
+    np.testing.assert_allclose(
+        np.asarray(sa.matmul(w)), want @ w, rtol=1e-5, atol=1e-5
+    )
+
+
+def test_sparse_array_index_out_of_range():
+    with pytest.raises(ValueError):
+        SparseArray.from_batch([[7]], dim=4, format="binary")
+
+
+def test_selected_rows_dedup_sums_duplicates():
+    rows = np.array([2, 0, 2, 5], np.int32)  # 5 == num_rows → padding
+    vals = np.arange(8, dtype=np.float32).reshape(4, 2)
+    sr = SelectedRows(rows, vals, num_rows=5)
+    dense = np.asarray(sr.to_dense())
+    want = np.zeros((5, 2), np.float32)
+    want[2] = vals[0] + vals[2]
+    want[0] = vals[1]
+    np.testing.assert_allclose(dense, want)
+    uniq, summed = sr.dedup()
+    redense = np.zeros((5, 2), np.float32)
+    for r, v in zip(np.asarray(uniq), np.asarray(summed)):
+        if r < 5:
+            redense[r] += v
+    np.testing.assert_allclose(redense, want)
+
+
+# ------------------------------------------------------------ feeder path --
+def test_feeder_builds_sparse_slots():
+    pt.reset()
+    with pt.program_guard(pt.Program(), pt.Program()):
+        xs = pt.layers.data("xs", shape=[6], sparse_format="binary")
+        xf = pt.layers.data("xf", shape=[6], sparse_format="float")
+        y = pt.layers.data("y", shape=[1], dtype=np.int32)
+        feeder = DataFeeder([xs, xf, y], bucket=16)
+    batch = [
+        ([0, 2], [(1, 0.5)], [1]),
+        ([5], [(4, -2.0), (0, 1.0)], [0]),
+    ]
+    feed = feeder.feed(batch)
+    assert isinstance(feed["xs"], SparseArray)
+    assert isinstance(feed["xf"], SparseArray)
+    assert feed["xs"].batch == 2 and feed["xs"].dim == 6
+    np.testing.assert_allclose(
+        np.asarray(feed["xs"].to_dense())[1], [0, 0, 0, 0, 0, 1]
+    )
+    np.testing.assert_allclose(
+        np.asarray(feed["xf"].to_dense())[1], [1.0, 0, 0, 0, -2.0, 0]
+    )
+    assert feed["y"].shape == (2, 1)
+
+
+# --------------------------------------------- sparse fc forward/backward --
+def _fc_program(sparse: bool, dim=8, out=4):
+    prog, startup = pt.Program(), pt.Program()
+    with pt.program_guard(prog, startup):
+        if sparse:
+            x = pt.layers.data("x", shape=[dim], sparse_format="binary")
+        else:
+            x = pt.layers.data("x", shape=[dim])
+        label = pt.layers.data("label", shape=[1], dtype=np.int32)
+        logits = pt.layers.fc(x, size=out, param_attr=pt.ParamAttr(name="W"),
+                              bias_attr=False)
+        loss = pt.layers.mean(
+            pt.layers.softmax_with_cross_entropy(logits, label)
+        )
+        pt.optimizer.SGD(learning_rate=0.5).minimize(loss)
+    return prog, startup, loss
+
+
+def test_sparse_fc_matches_dense_fc():
+    """Same model fed sparse vs dense must produce identical loss and an
+    identical W gradient step (the CpuSparseMatrix::mul equivalence)."""
+    samples = [[0, 3, 7], [2], [1, 5]]
+    dense_x = np.zeros((3, 8), np.float32)
+    for r, idxs in enumerate(samples):
+        dense_x[r, idxs] = 1.0
+    label = np.array([[0], [1], [2]], np.int32)
+
+    results = {}
+    for sparse in (False, True):
+        pt.reset()
+        prog, startup, loss = _fc_program(sparse)
+        prog.random_seed = startup.random_seed = 3
+        exe = pt.Executor()
+        exe.run(startup)
+        if sparse:
+            x = SparseArray.from_batch(samples, dim=8, format="binary",
+                                       bucket=16)
+        else:
+            x = dense_x
+        (l,) = exe.run(prog, feed={"x": x, "label": label},
+                       fetch_list=[loss])
+        results[sparse] = (float(l), np.asarray(pt.global_scope().get("W")))
+
+    assert results[True][0] == pytest.approx(results[False][0], rel=1e-5)
+    np.testing.assert_allclose(
+        results[True][1], results[False][1], rtol=1e-5, atol=1e-6
+    )
+
+
+# ------------------------------------------- SelectedRows embedding grads --
+def _emb_program(is_sparse: bool, optimizer):
+    prog, startup = pt.Program(), pt.Program()
+    with pt.program_guard(prog, startup):
+        ids = pt.layers.data("ids", shape=[4], dtype=np.int32,
+                             append_batch_size=True)
+        label = pt.layers.data("label", shape=[1], dtype=np.int32)
+        emb = pt.layers.embedding(
+            ids, size=(50, 6), is_sparse=is_sparse,
+            param_attr=pt.ParamAttr(name="emb_w"),
+        )
+        pooled = pt.layers.reduce_mean(emb, dim=1)
+        logits = pt.layers.fc(pooled, size=3)
+        loss = pt.layers.mean(
+            pt.layers.softmax_with_cross_entropy(logits, label)
+        )
+        optimizer().minimize(loss)
+    return prog, startup, loss
+
+
+def _run_emb(is_sparse, optimizer, steps=3):
+    pt.reset()
+    prog, startup, loss = _emb_program(is_sparse, optimizer)
+    prog.random_seed = startup.random_seed = 11
+    exe = pt.Executor()
+    exe.run(startup)
+    rng = np.random.RandomState(0)
+    w0 = np.asarray(pt.global_scope().get("emb_w")).copy()
+    # fixed batch: loss must fall monotonically-ish when overfitting it
+    ids = rng.randint(0, 20, (4, 4)).astype(np.int32)  # rows < 20 only
+    label = rng.randint(0, 3, (4, 1)).astype(np.int32)
+    losses = []
+    for s in range(steps):
+        (l,) = exe.run(prog, feed={"ids": ids, "label": label},
+                       fetch_list=[loss])
+        losses.append(float(l))
+    w1 = np.asarray(pt.global_scope().get("emb_w"))
+    return w0, w1, losses
+
+
+def test_sparse_embedding_sgd_matches_dense_grad():
+    """SGD is linear in the gradient, so SelectedRows (row-wise) updates
+    must match the dense-scatter path bit-for-bit-ish."""
+    w0d, w1d, ld = _run_emb(False, lambda: pt.optimizer.SGD(0.5))
+    w0s, w1s, ls = _run_emb(True, lambda: pt.optimizer.SGD(0.5))
+    np.testing.assert_allclose(w0d, w0s)  # same init
+    np.testing.assert_allclose(ld, ls, rtol=1e-5)
+    np.testing.assert_allclose(w1d, w1s, rtol=1e-4, atol=1e-6)
+
+
+def test_sparse_embedding_adam_is_lazy():
+    """Lazy adam must (a) train, (b) leave never-touched rows exactly at
+    their init, while dense adam drifts every row every step."""
+    w0s, w1s, ls = _run_emb(True, lambda: pt.optimizer.Adam(0.05), steps=5)
+    assert ls[-1] < ls[0]
+    untouched = slice(20, 50)  # ids were drawn < 20
+    np.testing.assert_allclose(w1s[untouched], w0s[untouched])
+    assert not np.allclose(w1s[:20], w0s[:20])  # touched rows moved
+    # and the touched-row trajectory matches dense adam (moments start at
+    # zero, so on a repeated batch lazy == dense for every touched row)
+    w0d, w1d, ld = _run_emb(False, lambda: pt.optimizer.Adam(0.05), steps=5)
+    np.testing.assert_allclose(ld, ls, rtol=1e-4)
+    np.testing.assert_allclose(w1d[:20], w1s[:20], rtol=1e-3, atol=1e-6)
+
+
+def test_sparse_embedding_momentum_and_adagrad_train():
+    for opt in (lambda: pt.optimizer.Momentum(0.1, 0.9),
+                lambda: pt.optimizer.Adagrad(0.1)):
+        w0, w1, ls = _run_emb(True, opt, steps=4)
+        assert ls[-1] < ls[0]
+        np.testing.assert_allclose(w1[30:], w0[30:])
+
+
+def test_sparse_fields_survive_program_roundtrip():
+    """to_dict/from_dict must carry sparse_update and sparse_format — a
+    restored program losing them would silently densify embedding grads /
+    break sparse feeding."""
+    pt.reset()
+    prog, startup = pt.Program(), pt.Program()
+    with pt.program_guard(prog, startup):
+        x = pt.layers.data("x", shape=[16], sparse_format="binary")
+        ids = pt.layers.data("ids", shape=[4], dtype=np.int32)
+        emb = pt.layers.embedding(ids, size=(10, 4), is_sparse=True,
+                                  param_attr=pt.ParamAttr(name="w_sp"))
+    restored = pt.Program.from_dict(prog.to_dict())
+    gb = restored.global_block()
+    assert gb.var("x").sparse_format == "binary"
+    assert gb.var("w_sp").sparse_update is True
+    assert gb.var("ids").sparse_format is None
+
+
+def test_sparse_embedding_rejects_tied_weight_use():
+    """A sparse_update table consumed by any non-lookup op (tied-embedding
+    output projection) must be rejected loudly — its gradient contribution
+    would otherwise silently vanish."""
+    pt.reset()
+    prog, startup = pt.Program(), pt.Program()
+    with pt.program_guard(prog, startup):
+        ids = pt.layers.data("ids", shape=[4], dtype=np.int32)
+        label = pt.layers.data("label", shape=[1], dtype=np.int32)
+        emb = pt.layers.embedding(ids, size=(30, 6), is_sparse=True,
+                                  param_attr=pt.ParamAttr(name="tied_w"))
+        pooled = pt.layers.reduce_mean(emb, dim=1)
+        w = prog.global_block().var("tied_w")
+        logits = pt.layers.matmul(pooled, w, transpose_y=True)  # tied use
+        loss = pt.layers.mean(
+            pt.layers.softmax_with_cross_entropy(logits, label)
+        )
+        pt.optimizer.SGD(0.1).minimize(loss)
+    exe = pt.Executor()
+    exe.run(startup)
+    with pytest.raises((ValueError, RuntimeError), match="sparse_update"):
+        exe.run(prog,
+                feed={"ids": np.zeros((2, 4), np.int32),
+                      "label": np.zeros((2, 1), np.int32)},
+                fetch_list=[loss])
+
+
+def test_sparse_embedding_with_lod_input():
+    """Ragged ids (LoD) through a sparse-update embedding: padding tokens
+    must not perturb row 0 (they are pointed out of range)."""
+    pt.reset()
+    prog, startup = pt.Program(), pt.Program()
+    with pt.program_guard(prog, startup):
+        words = pt.layers.data("words", shape=[-1], dtype=np.int32,
+                               lod_level=1, append_batch_size=False)
+        label = pt.layers.data("label", shape=[1], dtype=np.int32)
+        emb = pt.layers.embedding(words, size=(40, 8), is_sparse=True,
+                                  param_attr=pt.ParamAttr(name="emb_w"))
+        pooled = pt.layers.sequence_pool(emb, "sum")
+        logits = pt.layers.fc(pooled, size=2)
+        loss = pt.layers.mean(
+            pt.layers.softmax_with_cross_entropy(logits, label)
+        )
+        pt.optimizer.SGD(1.0).minimize(loss)
+    prog.random_seed = startup.random_seed = 5
+    exe = pt.Executor()
+    exe.run(startup)
+    from paddle_tpu.core.lod import LoDArray
+
+    w0 = np.asarray(pt.global_scope().get("emb_w")).copy()
+    # sequences use only ids 10..19; id 0 must stay untouched even though
+    # LoD padding slots hold 0
+    rng = np.random.RandomState(2)
+    seqs = [rng.randint(10, 20, (3,)).astype(np.int32),
+            rng.randint(10, 20, (5,)).astype(np.int32)]
+    lod = LoDArray.from_sequences(seqs, capacity=16, max_seqs=2)
+    label = np.array([[0], [1]], np.int32)
+    (l,) = exe.run(prog, feed={"words": lod, "label": label},
+                   fetch_list=[loss])
+    assert np.isfinite(l)
+    w1 = np.asarray(pt.global_scope().get("emb_w"))
+    np.testing.assert_allclose(w1[0], w0[0])  # padding did not touch row 0
+    assert not np.allclose(w1[10:20], w0[10:20])
