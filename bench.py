@@ -1,15 +1,38 @@
-"""Benchmark entry point: ResNet-50 training throughput on one TPU chip.
+"""Benchmark entry point: one-chip training throughput.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", "mfu_pct"}.
 
-Baseline: the reference's best published ResNet-50 *training* number,
-81.69 images/sec on a 2-socket Xeon 6148 with MKL-DNN at batch 64
-(BASELINE.md / benchmark/IntelOptimizedPaddle.md:38-45 — the reference
-has no GPU ResNet number in-tree). vs_baseline = ours / 81.69.
+Models (BENCH_MODEL):
+- "resnet" (default): ResNet-50 ImageNet-shape training, images/sec.
+  Baseline: the reference's best published ResNet-50 *training* number,
+  81.69 images/sec on a 2-socket Xeon 6148 with MKL-DNN at batch 64
+  (BASELINE.md / benchmark/IntelOptimizedPaddle.md:38-45 — the reference
+  has no GPU ResNet number in-tree). vs_baseline = ours / 81.69.
+- "lstm": the reference's headline RNN benchmark — 2x stacked LSTM text
+  classifier, hidden 512, batch 128, seq len 100, vocab 30k
+  (benchmark/paddle/rnn/rnn.py:4-37 + benchmark/README.md:103-127),
+  tokens/sec. Baseline: 261 ms/batch on a K40m at these settings
+  (benchmark/README.md:121-127) = 128*100/0.261 = 49,042 tokens/sec.
+- "nmt": seq2seq-attention NMT (BASELINE.json's second metric) — the book
+  machine_translation model at WMT scale (vocab 30k, emb/hidden 512,
+  bidirectional GRU encoder + attention GRU decoder, teacher forcing),
+  target tokens/sec. The reference published no seq2seq number
+  ("will be added later", benchmark/README.md:140-141) → vs_baseline null.
+
+MFU accounting: multiply and add counted separately (2 FLOPs/MAC), train
+step = fwd + bwd ~= 3x fwd; v5e bf16 peak 197 TFLOP/s.
 
 Env overrides: BENCH_BATCH (default 128 — best measured v5e throughput),
-BENCH_STEPS (default 16), BENCH_AMP (default 1 — bf16 MXU compute with
-f32 master weights).
+BENCH_STEPS (default 40 — the tunnel's d2h readback latency is ~100-200 ms,
+so short runs under-report; see PERF.md), BENCH_AMP (default 1 — bf16 MXU
+compute AND bf16 activations with f32 master weights), BENCH_LAYOUT
+(resnet only; default NHWC — channels-minor, the TPU-native layout),
+BENCH_HIDDEN / BENCH_SEQLEN (lstm only; defaults 512 / 100).
+
+BENCH_PIPELINE=1 measures the REAL input path instead of a device-staged
+batch: a host-side numpy reader → DevicePrefetcher (async double-buffered
+h2d) → per-step exe.run, i.e. what Trainer.train drives. The ratio to the
+device-staged number is the pipeline efficiency (PERF.md).
 """
 
 from __future__ import annotations
@@ -21,16 +44,20 @@ import time
 
 import numpy as np
 
+PEAK_FLOPS = 197e12  # TPU v5e bf16
+
 
 def _build_resnet_train(batch):
     import paddle_tpu as pt
     from paddle_tpu import models
 
+    fmt = os.environ.get("BENCH_LAYOUT", "NHWC")
+    shape = [3, 224, 224] if fmt == "NCHW" else [224, 224, 3]
     prog, startup = pt.Program(), pt.Program()
     with pt.program_guard(prog, startup):
-        img = pt.layers.data("img", shape=[3, 224, 224])
+        img = pt.layers.data("img", shape=shape)
         label = pt.layers.data("label", shape=[1], dtype=np.int32)
-        logits = models.resnet_imagenet(img, class_dim=1000)
+        logits = models.resnet_imagenet(img, class_dim=1000, data_format=fmt)
         loss = pt.layers.mean(
             pt.layers.softmax_with_cross_entropy(logits, label)
         )
@@ -39,55 +66,203 @@ def _build_resnet_train(batch):
         prog.set_amp("bfloat16")
     rng = np.random.RandomState(0)
     feed = {
-        "img": rng.randn(batch, 3, 224, 224).astype(np.float32),
+        "img": rng.randn(batch, *shape).astype(np.float32),
         "label": rng.randint(0, 1000, (batch, 1)).astype(np.int32),
     }
-    return prog, startup, feed, loss
+    # ResNet-50 fwd ~4.1 GMACs/img = 8.2 GFLOPs; train ~3x fwd
+    return dict(
+        prog=prog, startup=startup, feed=feed, loss=loss,
+        items_per_step=batch, item="images",
+        flops_per_item=3 * 8.2e9,
+        metric="resnet50_train_images_per_sec",
+        baseline=81.69,
+    )
+
+
+def _build_lstm_train(batch):
+    import paddle_tpu as pt
+    from paddle_tpu import models
+    from paddle_tpu.core.lod import LoDArray
+
+    hidden = int(os.environ.get("BENCH_HIDDEN", 512))
+    seqlen = int(os.environ.get("BENCH_SEQLEN", 100))
+    vocab, emb_dim = 30000, 128
+    prog, startup = pt.Program(), pt.Program()
+    with pt.program_guard(prog, startup):
+        words = pt.layers.data("words", shape=[-1], dtype=np.int32,
+                               lod_level=1, append_batch_size=False)
+        label = pt.layers.data("label", shape=[1], dtype=np.int32)
+        logits = models.lstm_benchmark_net(
+            words, vocab_size=vocab, emb_dim=emb_dim, hidden=hidden,
+            max_len=seqlen,
+        )
+        loss = pt.layers.mean(
+            pt.layers.softmax_with_cross_entropy(logits, label)
+        )
+        # reference settings (benchmark/paddle/rnn/rnn.py:20-25): Adam,
+        # L2Regularization(8e-4), gradient_clipping_threshold=25
+        from paddle_tpu import regularizer as reg
+
+        pt.optimizer.Adam(
+            learning_rate=2e-3,
+            regularization=reg.L2Decay(8e-4),
+            grad_clip=pt.optimizer.GradientClipByGlobalNorm(25.0),
+        ).minimize(loss)
+    if os.environ.get("BENCH_AMP", "1") == "1":
+        prog.set_amp("bfloat16")
+    rng = np.random.RandomState(0)
+    seqs = [rng.randint(0, vocab, (seqlen,)).astype(np.int32)
+            for _ in range(batch)]
+    feed = {
+        "words": LoDArray.from_sequences(
+            seqs, capacity=batch * seqlen, max_seqs=batch),
+        "label": rng.randint(0, 2, (batch, 1)).astype(np.int32),
+    }
+    # fwd FLOPs/token: per LSTM layer the x-projection (fc emb/H -> 4H) +
+    # recurrent matmul (H -> 4H), MACs x2; embedding gather and the final
+    # fc are negligible. train ~3x fwd.
+    gates = 4 * hidden
+    fwd = 2 * gates * (emb_dim + hidden) + 2 * gates * (hidden + hidden)
+    return dict(
+        prog=prog, startup=startup, feed=feed, loss=loss,
+        items_per_step=batch * seqlen, item="tokens",
+        flops_per_item=3 * fwd,
+        metric=f"lstm_h{hidden}_train_tokens_per_sec",
+        # 261 ms/batch @ h=512 bs=128 len=100 on K40m (benchmark/README.md:121-127)
+        baseline=128 * 100 / 0.261 if hidden == 512 else None,
+    )
+
+
+def _build_nmt_train(batch):
+    import paddle_tpu as pt
+    from paddle_tpu import models
+    from paddle_tpu.core.lod import LoDArray
+
+    hidden = int(os.environ.get("BENCH_HIDDEN", 512))
+    seqlen = int(os.environ.get("BENCH_SEQLEN", 50))
+    vocab, emb_dim = 30000, hidden
+    prog, startup = pt.Program(), pt.Program()
+    with pt.program_guard(prog, startup):
+        src = pt.layers.data("src", shape=[-1], dtype=np.int32, lod_level=1,
+                             append_batch_size=False)
+        trg_in = pt.layers.data("trg_in", shape=[-1], dtype=np.int32,
+                                lod_level=1, append_batch_size=False)
+        label = pt.layers.data("label", shape=[-1], dtype=np.int32,
+                               lod_level=1, append_batch_size=False)
+        logits = models.seq2seq_attention(
+            src, trg_in, src_vocab=vocab, trg_vocab=vocab,
+            emb_dim=emb_dim, enc_hidden=hidden, dec_hidden=hidden,
+            src_max_len=seqlen, trg_max_len=seqlen,
+        )
+        tok_loss = pt.layers.softmax_with_cross_entropy(logits, label)
+        loss = pt.layers.mean(pt.layers.sequence_pool(tok_loss, "sum"))
+        pt.optimizer.Adam(learning_rate=5e-4).minimize(loss)
+    if os.environ.get("BENCH_AMP", "1") == "1":
+        prog.set_amp("bfloat16")
+    rng = np.random.RandomState(0)
+    pack = lambda seqs: LoDArray.from_sequences(  # noqa: E731
+        seqs, capacity=batch * seqlen, max_seqs=batch)
+    srcs = [rng.randint(2, vocab, (seqlen,)).astype(np.int32)
+            for _ in range(batch)]
+    trgs = [rng.randint(2, vocab, (seqlen,)).astype(np.int32)
+            for _ in range(batch)]
+    feed = {
+        "src": pack(srcs),
+        "trg_in": pack(trgs),
+        "label": pack(trgs),
+    }
+    # fwd FLOPs per target token (MACs x2), H=hidden, E=emb, Ts=src len:
+    # encoder (2 GRUs + x-projections, amortized per src token ~ per trg
+    # token at equal lengths): 2*3H*(E+H) proj+rec each direction;
+    # decoder GRU: 2*3H*(E+2H+H); attention: score MLP ~2*Ts*(3H*H)/H ...
+    # dominated by the output projection 2*H*vocab. Sum the big terms:
+    H, E, V, Ts = hidden, emb_dim, vocab, seqlen
+    enc = 2 * (2 * 3 * H * (E + H))         # both directions
+    dec = 2 * 3 * H * (E + 2 * H + H)       # input feeds [emb, ctx]
+    attn = 2 * Ts * (3 * H)                 # scores+softmax+ctx per trg tok
+    out = 2 * H * V
+    fwd = enc + dec + attn + out
+    return dict(
+        prog=prog, startup=startup, feed=feed, loss=loss,
+        items_per_step=batch * seqlen, item="tokens",
+        flops_per_item=3 * fwd,
+        metric=f"seq2seq_attention_h{hidden}_train_tokens_per_sec",
+        baseline=None,
+    )
 
 
 def main():
     batch = int(os.environ.get("BENCH_BATCH", 128))
-    steps = int(os.environ.get("BENCH_STEPS", 16))
+    steps = int(os.environ.get("BENCH_STEPS", 40))
+    model = os.environ.get("BENCH_MODEL", "resnet")
 
     import jax
 
     import paddle_tpu as pt
 
-    prog, startup, feed, loss = _build_resnet_train(batch)
+    build = {"resnet": _build_resnet_train, "lstm": _build_lstm_train,
+             "nmt": _build_nmt_train}[model]
+    cfg = build(batch)
+    prog, loss = cfg["prog"], cfg["loss"]
     exe = pt.Executor(donate_state=True)
-    exe.run(startup)
+    exe.run(cfg["startup"])
 
-    # stage the batch on device once: training input pipelines prefetch
-    # to device (paddle_tpu/data/feeder.py); per-step host→device transfer
-    # would measure the PCIe/tunnel link, not the chip
-    feed = {k: jax.device_put(v) for k, v in feed.items()}
+    if os.environ.get("BENCH_PIPELINE") == "1":
+        from paddle_tpu.data.feeder import DevicePrefetcher
 
-    # warmup (compile + first steps)
-    for _ in range(3):
-        (l,) = exe.run(prog, feed=feed, fetch_list=[loss])
-    assert np.isfinite(l), f"non-finite loss {l}"
+        def reader():
+            while True:  # unbounded; consumer breaks
+                yield cfg["feed"]
 
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        (l,) = exe.run(prog, feed=feed, fetch_list=[loss], return_numpy=False)
-    # d2h read of the final loss forces completion of the whole step chain
-    # (each step's update feeds the next); avoids a per-step host sync
-    l = float(np.asarray(l))
-    dt = time.perf_counter() - t0
-    assert np.isfinite(l), f"non-finite loss {l}"
+        # warmup pass (compile)
+        (l,) = exe.run(prog, feed=cfg["feed"], fetch_list=[loss])
+        assert np.isfinite(l), f"non-finite loss {l}"
+        it = iter(DevicePrefetcher(lambda: reader(), depth=2))
+        n = 0
+        t0 = time.perf_counter()
+        for feed in it:
+            (l,) = exe.run(prog, feed=feed, fetch_list=[loss],
+                           return_numpy=False)
+            n += 1
+            if n >= steps:
+                break
+        l = float(np.asarray(l))
+        dt = time.perf_counter() - t0
+        assert np.isfinite(l), f"non-finite loss {l}"
+    else:
+        # stage the batch on device once: training input pipelines prefetch
+        # to device (paddle_tpu/data/feeder.py); per-step host→device
+        # transfer would measure the PCIe/tunnel link, not the chip
+        feed = {k: jax.device_put(v) for k, v in cfg["feed"].items()}
 
-    images_per_sec = batch * steps / dt
-    baseline = 81.69  # ref ResNet-50 train img/s, MKL-DNN bs64 (BASELINE.md)
-    print(
-        json.dumps(
-            {
-                "metric": "resnet50_train_images_per_sec",
-                "value": round(images_per_sec, 2),
-                "unit": "images/sec",
-                "vs_baseline": round(images_per_sec / baseline, 3),
-            }
-        )
-    )
+        # warmup (compile + first steps)
+        for _ in range(3):
+            (l,) = exe.run(prog, feed=feed, fetch_list=[loss])
+        assert np.isfinite(l), f"non-finite loss {l}"
+
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            (l,) = exe.run(prog, feed=feed, fetch_list=[loss],
+                           return_numpy=False)
+        # d2h read of the final loss forces completion of the whole step
+        # chain (each step's update feeds the next); no per-step host sync
+        l = float(np.asarray(l))
+        dt = time.perf_counter() - t0
+        assert np.isfinite(l), f"non-finite loss {l}"
+
+    items_per_sec = cfg["items_per_step"] * steps / dt
+    mfu = items_per_sec * cfg["flops_per_item"] / PEAK_FLOPS
+    out = {
+        "metric": cfg["metric"],
+        "value": round(items_per_sec, 2),
+        "unit": f"{cfg['item']}/sec",
+        "vs_baseline": (
+            round(items_per_sec / cfg["baseline"], 3) if cfg["baseline"]
+            else None
+        ),
+        "mfu_pct": round(100 * mfu, 1),
+    }
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":

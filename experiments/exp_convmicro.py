@@ -1,0 +1,30 @@
+import time, sys, jax, jax.numpy as jnp, numpy as np
+
+def timeit(f, *args, n=5):
+    r = f(*args); np.asarray(jax.tree.leaves(r)[0].ravel()[0])
+    t0=time.perf_counter()
+    for _ in range(n): r = f(*args)
+    np.asarray(jax.tree.leaves(r)[0].ravel()[0])
+    return (time.perf_counter()-t0)/n
+
+B=128
+configs = [
+    (64, 64, 3, 56, 1), (64, 256, 1, 56, 1),
+    (128, 128, 3, 28, 1), (256, 256, 3, 14, 1),
+    (512, 512, 3, 7, 1), (3, 64, 7, 224, 2),
+]
+for cin,cout,k,hw,st in configs:
+    x = jnp.asarray(np.random.randn(B,hw,hw,cin), jnp.bfloat16)
+    w = jnp.asarray(np.random.randn(k,k,cin,cout), jnp.bfloat16)
+    pad=(k-1)//2
+    f = jax.jit(lambda x,w,st=st,pad=pad: jax.lax.conv_general_dilated(x,w,(st,st),[(pad,pad)]*2, dimension_numbers=("NHWC","HWIO","NHWC")))
+    dt = timeit(f,x,w)
+    ho=hw//st
+    fl = 2*B*ho*ho*cout*cin*k*k
+    print(f"conv {cin:4d}->{cout:4d} k{k} {hw}x{hw}/{st}: {dt*1e3:7.2f} ms {fl/dt/1e12:6.1f} TF/s", flush=True)
+x = jnp.asarray(np.random.randn(B*28*28, 512), jnp.bfloat16)
+w = jnp.asarray(np.random.randn(512, 512), jnp.bfloat16)
+f = jax.jit(lambda x,w: x@w)
+dt = timeit(f,x,w)
+fl = 2*x.shape[0]*512*512
+print(f"matmul [{x.shape[0]}x512]@[512x512]: {dt*1e3:.2f} ms {fl/dt/1e12:.1f} TF/s", flush=True)
