@@ -125,10 +125,9 @@ def bench(layout, batch, bf16=True, steps=40):
     np.asarray(jax.tree.leaves(p)[0])[0]
     dt = (time.perf_counter() - t0) / steps
     imgs = batch / dt
-    flops = 3 * 2 * 12.3e9 * batch  # fwd+bwd ~3x fwd, ~12.3 GFLOP/img WRONG see below
-    # ResNet-50 fwd ≈ 4.1 GFLOPs/img (multiply-add counted as 2);
-    # train step ≈ 3x fwd ≈ 12.3 GFLOPs/img
-    mfu = (12.3e9 * batch / dt) / 197e12
+    # bench.py accounting: fwd = 4.1 GMACs = 8.2 GFLOPs (2 FLOPs/MAC),
+    # train = fwd + bwd ~= 3x fwd
+    mfu = (3 * 8.2e9 * batch / dt) / 197e12
     print(f"{layout} bs={batch} bf16={bf16}: {dt*1e3:.1f} ms/step, "
           f"{imgs:.0f} img/s, MFU={mfu*100:.1f}%", flush=True)
     return imgs

@@ -1,0 +1,82 @@
+"""Flash attention: fused block-wise attention for long sequences.
+
+Reference lineage: the reference (2017) predates transformer attention —
+its fused-kernel philosophy lives in cuda/include/hl_lstm.h:42; this is
+the modern long-context analogue (SURVEY.md §5.7's "seam for future
+CP/ring-attention"). XLA's unfused attention materializes the [B, H, T, T]
+score matrix in HBM (16 GB at T=32k bf16 — impossible); flash attention
+streams K/V blocks through VMEM with an online softmax, O(T) memory.
+
+Compute path: on TPU, JAX's Pallas TPU flash kernel
+(jax.experimental.pallas.ops.tpu.flash_attention — public JAX library
+code, used the way lax.conv uses XLA) with its custom VJP; anywhere else,
+the jnp reference formulation. Layout here is [B, T, H, D] (the
+framework's sequence-parallel convention, parallel/ring_attention.py);
+the kernel's [B, H, T, D] transpose happens at the boundary and XLA
+folds it into the kernel's operand layout.
+
+`paddle_tpu.parallel.ulysses_attention` routes its per-device full-
+sequence attention through here, so the SP path gets the fused kernel
+for free.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30  # large-finite mask fill (inf would NaN the softmax grads)
+
+
+def scaled_dot_product_attention(q, k, v, causal: bool = False):
+    """[B, T, H, D] attention, plain jnp — the numerical oracle for the
+    flash kernel AND for ring/Ulysses sequence parallelism (re-exported
+    by paddle_tpu.parallel; single implementation lives here)."""
+    d = q.shape[-1]
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) / math.sqrt(d)
+    if causal:
+        Tq, Tk = s.shape[-2], s.shape[-1]
+        mask = jnp.arange(Tq)[:, None] >= jnp.arange(Tk)[None, :]
+        s = jnp.where(mask, s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+
+
+_reference = scaled_dot_product_attention
+
+
+def _shapes_flash_ok(q, k) -> bool:
+    """Backend-independent shape rules (separately testable): 128-aligned
+    q AND kv sequence lengths (the kernel's block divisibility — default
+    blocks are 128 and clamp to the sequence), lane-aligned head dim."""
+    Tq, Dq = q.shape[1], q.shape[3]
+    Tk = k.shape[1]
+    return Tq % 128 == 0 and Tk % 128 == 0 and Dq in (64, 128, 256)
+
+
+def flash_eligible(q, k=None) -> bool:
+    return jax.default_backend() == "tpu" and _shapes_flash_ok(
+        q, q if k is None else k
+    )
+
+
+def flash_attention(q, k, v, causal: bool = False):
+    """[B, T, H, D] attention; fused TPU kernel when eligible, else the
+    jnp reference. Numerics: bf16 io with f32 online-softmax accumulation
+    inside the kernel (matches the reference formulation to bf16 eps)."""
+    if q.ndim != 4:
+        raise ValueError(f"expected [B, T, H, D], got {q.shape}")
+    if not flash_eligible(q, k):
+        return _reference(q, k, v, causal)
+    from jax.experimental.pallas.ops.tpu.flash_attention import (
+        flash_attention as _tpu_flash,
+    )
+
+    bhtd = lambda x: jnp.transpose(x, (0, 2, 1, 3))  # noqa: E731
+    o = _tpu_flash(
+        bhtd(q), bhtd(k), bhtd(v), causal=causal,
+        sm_scale=float(1.0 / math.sqrt(q.shape[-1])),
+    )
+    return jnp.transpose(o, (0, 2, 1, 3))

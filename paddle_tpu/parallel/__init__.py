@@ -41,4 +41,5 @@ from .ring_attention import (  # noqa: F401
     scaled_dot_product_attention,
     ulysses_attention,
 )
+from ..ops.flash_ops import flash_attention  # noqa: F401
 from .sharded_embedding import sharded_embedding  # noqa: F401

@@ -35,19 +35,14 @@ from jax.sharding import Mesh, PartitionSpec
 from .collective import ppermute_ring
 from .mesh import SP
 
-NEG_INF = -1e30
-
-
-def scaled_dot_product_attention(q, k, v, causal: bool = False):
-    """[B, T, H, D] attention (single device); the ring oracle."""
-    d = q.shape[-1]
-    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) / math.sqrt(d)
-    if causal:
-        Tq, Tk = s.shape[-2], s.shape[-1]
-        mask = jnp.arange(Tq)[:, None] >= jnp.arange(Tk)[None, :]
-        s = jnp.where(mask, s, NEG_INF)
-    p = jax.nn.softmax(s, axis=-1)
-    return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+# single oracle implementation + dispatching flash kernel live in
+# ops/flash_ops.py (ops never imports parallel, so this direction is
+# cycle-free); re-exported here for the established parallel API
+from ..ops.flash_ops import (  # noqa: F401
+    NEG_INF,
+    flash_attention,
+    scaled_dot_product_attention,
+)
 
 
 def _ring_attention_shard(q, k, v, axis_name: str, causal: bool):
@@ -112,7 +107,10 @@ def _ulysses_shard(q, k, v, axis_name: str, causal: bool):
                            tiled=True)
         for x in (q, k, v)
     )
-    o = scaled_dot_product_attention(q, k, v, causal=causal)
+    # full-sequence attention per head subset: the fused flash kernel when
+    # on TPU/eligible (O(T) memory — the point of sequence parallelism),
+    # the jnp reference elsewhere (ops/flash_ops.py dispatch)
+    o = flash_attention(q, k, v, causal=causal)
     # [B, T, H/n, D] -> [B, Tl, H, D]
     return jax.lax.all_to_all(o, axis_name, split_axis=1, concat_axis=2,
                               tiled=True)

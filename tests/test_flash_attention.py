@@ -1,0 +1,83 @@
+"""Flash attention dispatch + reference-path tests (CPU).
+
+The fused TPU kernel itself is validated on hardware by
+experiments/exp_flash.py (correctness vs the jnp oracle to bf16 eps +
+benchmarks/flash_attention_microbench.json, incl. the T=32k capability
+row where the XLA formulation cannot compile). On the CPU CI mesh the
+dispatcher must fall back to the reference formulation, which these
+tests pin against scaled_dot_product_attention.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as pt  # noqa: F401  (registers ops; forces CPU in CI)
+from paddle_tpu import parallel as pp
+from paddle_tpu.ops.flash_ops import flash_attention, flash_eligible
+
+
+def _qkv(B=2, T=16, H=2, D=8, seed=0):
+    rng = np.random.RandomState(seed)
+    mk = lambda: jnp.asarray(rng.randn(B, T, H, D).astype(np.float32))
+    return mk(), mk(), mk()
+
+
+def test_cpu_falls_back_to_reference():
+    q, k, v = _qkv()
+    assert jax.default_backend() != "tpu"  # conftest forces CPU
+    assert not flash_eligible(q)
+    out = flash_attention(q, k, v, causal=True)
+    ref = pp.scaled_dot_product_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_non_causal_matches_oracle():
+    q, k, v = _qkv(seed=3)
+    out = flash_attention(q, k, v, causal=False)
+    ref = pp.scaled_dot_product_attention(q, k, v, causal=False)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_gradients_flow():
+    q, k, v = _qkv(seed=5)
+    g = jax.grad(lambda q: jnp.sum(flash_attention(q, k, v, causal=True)))(q)
+    assert np.all(np.isfinite(np.asarray(g)))
+    assert float(jnp.abs(g).max()) > 0
+
+
+def test_rank_check():
+    with pytest.raises(ValueError, match="B, T, H, D"):
+        flash_attention(jnp.zeros((4, 8, 2)), jnp.zeros((4, 8, 2)),
+                        jnp.zeros((4, 8, 2)))
+
+
+def test_eligibility_rules():
+    """Shape rules are tested backend-independently (_shapes_flash_ok) —
+    on the CPU mesh flash_eligible is False for everything via the
+    backend check alone, which the fallback test covers."""
+    from paddle_tpu.ops.flash_ops import _shapes_flash_ok
+
+    ok = jnp.zeros((1, 256, 2, 128))
+    assert _shapes_flash_ok(ok, ok)
+    assert not _shapes_flash_ok(jnp.zeros((1, 100, 2, 128)), ok)  # q T
+    assert not _shapes_flash_ok(ok, jnp.zeros((1, 100, 2, 128)))  # kv T
+    assert not _shapes_flash_ok(jnp.zeros((1, 256, 2, 48)), ok)   # head dim
+    assert not flash_eligible(ok)  # CPU backend gate
+
+
+def test_ulysses_uses_flash_dispatch_path():
+    """Ulysses routes local attention through flash_attention; on the CPU
+    mesh that's the reference formulation — results must still match the
+    single-device oracle exactly."""
+    mesh = pp.make_mesh((8,), (pp.SP,))
+    rng = np.random.RandomState(1)
+    q = jnp.asarray(rng.randn(2, 32, 8, 4).astype(np.float32))
+    out = pp.ulysses_attention(q, q, q, mesh, causal=True)
+    ref = pp.scaled_dot_product_attention(q, q, q, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-4, atol=1e-5)
