@@ -18,6 +18,10 @@ Models (BENCH_MODEL):
   bidirectional GRU encoder + attention GRU decoder, teacher forcing),
   target tokens/sec. The reference published no seq2seq number
   ("will be added later", benchmark/README.md:140-141) → vs_baseline null.
+- "transformer": decoder-only transformer LM (GPT-small-ish: dim 768,
+  12 heads, 12 layers, T=1024, vocab 32k) through the flash-attention
+  dispatcher — beyond the 2017 reference (vs_baseline null); the modern
+  long-context model family at its natural MFU.
 
 MFU accounting: multiply and add counted separately (2 FLOPs/MAC), train
 step = fwd + bwd ~= 3x fwd; v5e bf16 peak 197 TFLOP/s.
@@ -191,6 +195,46 @@ def _build_nmt_train(batch):
     )
 
 
+def _build_transformer_train(batch):
+    import paddle_tpu as pt
+    from paddle_tpu import models
+
+    dim = int(os.environ.get("BENCH_HIDDEN", 768))
+    seqlen = int(os.environ.get("BENCH_SEQLEN", 1024))
+    heads, depth, vocab = dim // 64, 12, 32000
+    prog, startup = pt.Program(), pt.Program()
+    with pt.program_guard(prog, startup):
+        toks = pt.layers.data("toks", shape=[seqlen], dtype=np.int32)
+        labels = pt.layers.data("labels", shape=[seqlen, 1], dtype=np.int32)
+        logits = models.transformer_lm(
+            toks, vocab_size=vocab, dim=dim, num_heads=heads,
+            num_layers=depth, max_len=seqlen,
+        )
+        loss = pt.layers.mean(
+            pt.layers.softmax_with_cross_entropy(logits, labels)
+        )
+        pt.optimizer.Adam(learning_rate=3e-4).minimize(loss)
+    if os.environ.get("BENCH_AMP", "1") == "1":
+        prog.set_amp("bfloat16")
+    rng = np.random.RandomState(0)
+    feed = {
+        "toks": rng.randint(0, vocab, (batch, seqlen)).astype(np.int32),
+        "labels": rng.randint(0, vocab, (batch, seqlen, 1)).astype(np.int32),
+    }
+    # fwd FLOPs/token (2 FLOPs/MAC): per layer qkvo 4*dim^2 + ffn 8*dim^2
+    # MACs (x2), causal attention 2 matmuls * T*dim /2; plus the output
+    # head dim*vocab. train ~3x fwd.
+    fwd = (depth * (2 * 12 * dim * dim + 2 * seqlen * dim)
+           + 2 * dim * vocab)
+    return dict(
+        prog=prog, startup=startup, feed=feed, loss=loss,
+        items_per_step=batch * seqlen, item="tokens",
+        flops_per_item=3 * fwd,
+        metric=f"transformer_lm_d{dim}_train_tokens_per_sec",
+        baseline=None,
+    )
+
+
 def main():
     batch = int(os.environ.get("BENCH_BATCH", 128))
     steps = int(os.environ.get("BENCH_STEPS", 40))
@@ -201,7 +245,8 @@ def main():
     import paddle_tpu as pt
 
     build = {"resnet": _build_resnet_train, "lstm": _build_lstm_train,
-             "nmt": _build_nmt_train}[model]
+             "nmt": _build_nmt_train,
+             "transformer": _build_transformer_train}[model]
     cfg = build(batch)
     prog, loss = cfg["prog"], cfg["loss"]
     exe = pt.Executor(donate_state=True)

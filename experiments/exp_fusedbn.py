@@ -99,9 +99,7 @@ def fused_layer(x, w, stats, gamma, beta, apply_bn, block_rows=1024):
 def chain_fused(x, ws, gammas, betas, L, N):
     stats = None
     for k in range(L):
-        y, (s, sq) = fused_layer(x, ws[k], stats,
-                                 gammas[k] if stats is not None else gammas[k],
-                                 betas[k] if stats is not None else betas[k],
+        y, (s, sq) = fused_layer(x, ws[k], stats, gammas[k], betas[k],
                                  apply_bn=stats is not None)
         mean = s / N
         var = sq / N - mean * mean
@@ -145,12 +143,16 @@ def main():
     REPS = 20
 
     def many(f):
+        # carry in x's dtype (an f32 carry would promote the bf16 input)
+        # and a real (tiny) dependence so nothing is folded away
         @jax.jit
         def run(x):
-            def body(c, _):
-                return c + f(x + c * 0.0) * 0.0, None
-            c, _ = jax.lax.scan(body, jnp.float32(0), None, length=REPS)
-            return c
+            def body(xc, _):
+                l = f(xc)
+                return xc * jnp.asarray(1.0, xc.dtype) + jnp.asarray(
+                    1e-12, xc.dtype) * l.astype(xc.dtype), l
+            xc, ls = jax.lax.scan(body, x, None, length=REPS)
+            return ls[-1]
         return run
 
     t_x = timeit(many(lambda x: chain_xla(x, ws, gs, bs, L, N)), x, reps=REPS)

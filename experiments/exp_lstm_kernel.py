@@ -36,8 +36,10 @@ def bench(T, B, H, dtype, reps=30):
     mask = jnp.ones((T, B), jnp.float32)
 
     def many(core):
-        # chain `reps` evaluations with a data dependency so nothing is
-        # hoisted; fwd+bwd wrt x and w (training shape)
+        # chain `reps` evaluations; the carry must REALLY depend on the
+        # gradients (tiny nonzero scale, same dtype) or XLA dead-code
+        # eliminates the whole backward pass — `x + 0.0 * dx` gets folded
+        # and the "fwd+bwd" bench silently times forward only
         def loss(x, w):
             h_seq, (hT, cT) = core(x, mask, w)
             return jnp.sum(hT.astype(jnp.float32))
@@ -47,7 +49,8 @@ def bench(T, B, H, dtype, reps=30):
             def body(carry, _):
                 x, w = carry
                 l, (dx, dw) = jax.value_and_grad(loss, argnums=(0, 1))(x, w)
-                return (x + 0.0 * dx, w + 0.0 * dw), l
+                eps = jnp.asarray(1e-12, x.dtype)
+                return (x + eps * dx, w + eps * dw), l
             (x, w), ls = jax.lax.scan(body, (x, w), None, length=reps)
             return ls[-1]
         return run

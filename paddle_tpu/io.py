@@ -47,6 +47,8 @@ __all__ = [
     "load_checkpoint",
     "clean_checkpoint",
     "get_latest_checkpoint_serial",
+    "save_sharded_checkpoint",
+    "load_sharded_checkpoint",
 ]
 
 PARAMS_FILE = "params.npz"
@@ -260,14 +262,22 @@ def save_checkpoint(
     main_program: Optional[Program] = None,
     scope: Optional[Scope] = None,
     max_num_checkpoints: int = 3,
+    sharded: bool = False,
 ) -> int:
     """Save persistables + trainer metadata as a new numbered checkpoint,
     keeping only the newest `max_num_checkpoints` (ParamUtil cadence +
-    `save_only_one` generalized). Returns the new serial."""
+    `save_only_one` generalized). Returns the new serial.
+
+    sharded=True uses the orbax-style per-shard format (each process
+    writes only shards it owns — no all-gather; see the sharded section
+    below) instead of the single gathered npz."""
     serial = get_latest_checkpoint_serial(checkpoint_dir) + 1
     d = _serial_dir(checkpoint_dir, serial)
     os.makedirs(d, exist_ok=True)
-    save_persistables(d, main_program, scope)
+    if sharded:
+        save_sharded_checkpoint(d, main_program, scope)
+    else:
+        save_persistables(d, main_program, scope)
     # meta written last: its presence marks the checkpoint complete
     with open(os.path.join(d, META_FILE), "w") as f:
         json.dump({"serial": serial, "trainer_args": trainer_args or {}}, f)
@@ -291,10 +301,191 @@ def load_checkpoint(
     if serial < 0:
         raise FileNotFoundError(f"no checkpoint under {checkpoint_dir}")
     d = _serial_dir(checkpoint_dir, serial)
-    load_persistables(d, main_program, scope)
+    if os.path.exists(os.path.join(d, SHARDED_META)):
+        load_sharded_checkpoint(d, main_program, scope)
+    else:
+        load_persistables(d, main_program, scope)
     with open(os.path.join(d, META_FILE)) as f:
         return json.load(f)["trainer_args"]
 
 
 def clean_checkpoint(checkpoint_dir: str) -> None:
     shutil.rmtree(checkpoint_dir, ignore_errors=True)
+
+
+# ---------------------------------------------------------------------------
+# sharded checkpoints (orbax-style; SURVEY §5.4 "sharded checkpoint of
+# params+opt state"; replaces the pserver's parameter-block persistence,
+# go/pserver/service.go:346)
+# ---------------------------------------------------------------------------
+#
+# The single-file path above gathers every sharded array to one host
+# (np.asarray = implicit all-gather) — fine on one chip, wrong at scale:
+# a ZeRO-sharded optimizer state or an mp-sharded embedding would spike
+# HBM/ICI and write dp-redundant bytes. The sharded format instead has
+# each PROCESS write only the shards it owns (replica 0 of each), so save
+# traffic is exactly one device→host copy of each unique shard:
+#
+#   dir/
+#     sharded_meta.json          # global shapes/dtypes + shard index map
+#     shards_p{K}.npz            # process K's unique shards, keyed
+#                                # "<var>::<linear shard idx>"
+#
+# Restore assembles global host arrays from all shard files (every
+# process reads the manifest + files it can see — a shared filesystem,
+# like the reference's cluster save path) and sets them into the Scope;
+# the next ParallelExecutor step re-shards them onto the mesh via its
+# in_shardings. Mid-pass resume, cadence, and latest-pointer semantics
+# come from the serial-checkpoint layer above, which delegates here when
+# `sharded=True`.
+
+SHARDED_META = "sharded_meta.json"
+
+
+def save_sharded_checkpoint(
+    dirname: str,
+    main_program: Optional[Program] = None,
+    scope: Optional[Scope] = None,
+) -> str:
+    import jax
+
+    program = main_program or default_main_program()
+    scope = scope or global_scope()
+    os.makedirs(dirname, exist_ok=True)
+    pid = jax.process_index()
+    names = sorted(v.name for v in program.persistables() if scope.has(v.name))
+
+    meta: Dict[str, Any] = {"vars": {}, "num_processes": jax.process_count()}
+    local: Dict[str, np.ndarray] = {}
+    for n in names:
+        val = scope.get(n)
+        shards = getattr(val, "addressable_shards", None)
+        if shards is None or getattr(val, "is_fully_replicated", True):
+            # replicated / host value: chief saves one copy
+            meta["vars"][n] = {"kind": "replicated"}
+            if pid == 0:
+                local[f"{n}::r"] = _to_host(val)
+            continue
+        entries = []
+        for s in shards:
+            if s.replica_id != 0:
+                continue  # exactly one owner per unique shard
+            # record the global slice this shard covers
+            idx = [
+                [0 if sl.start is None else int(sl.start),
+                 dim if sl.stop is None else int(sl.stop)]
+                for sl, dim in zip(s.index, val.shape)
+            ]
+            key = f"{n}::{len(entries)}"
+            local[key] = np.asarray(s.data)
+            entries.append({"key": key, "slice": idx, "process": pid})
+        meta["vars"][n] = {
+            "kind": "sharded",
+            "shape": list(val.shape),
+            "dtype": np.dtype(val.dtype).name,
+            "shards": entries,
+        }
+
+    # a reused dirname must not leak a previous save's files into this
+    # one: each process clears its own stale outputs first (and the chief
+    # clears any leftover merged manifest)
+    for stale in (f"shards_p{pid}.npz", f"manifest_p{pid}.json"):
+        path = os.path.join(dirname, stale)
+        if os.path.exists(path):
+            os.remove(path)
+    if pid == 0 and os.path.exists(os.path.join(dirname, SHARDED_META)):
+        os.remove(os.path.join(dirname, SHARDED_META))
+
+    fd, tmp = tempfile.mkstemp(dir=dirname, suffix=".tmp")
+    os.close(fd)
+    try:
+        with open(tmp, "wb") as f:
+            np.savez(f, **local)
+        os.replace(tmp, os.path.join(dirname, f"shards_p{pid}.npz"))
+    finally:
+        if os.path.exists(tmp):
+            os.remove(tmp)
+    # multi-process: every process contributes its shard entries; merge by
+    # writing per-process manifests and letting the chief fold them AFTER
+    # a cross-process barrier — folding early would silently drop peers'
+    # shards and the loader would zero-fill their slices
+    with open(os.path.join(dirname, f"manifest_p{pid}.json"), "w") as f:
+        json.dump(meta, f)
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices("ptpu_sharded_ckpt_save")
+    if pid == 0:
+        _fold_sharded_manifests(dirname, meta)
+    return dirname
+
+
+def _fold_sharded_manifests(dirname: str, chief_meta: Dict[str, Any]) -> None:
+    """Chief merges every process's shard entries into sharded_meta.json.
+    Only manifests from the CURRENT job's process ids are folded (stale
+    higher-numbered files from an earlier, larger job are ignored); a
+    missing expected manifest is an error, not a silent omission."""
+    merged = json.loads(json.dumps(chief_meta))
+    nproc = chief_meta["num_processes"]
+    for p in range(1, nproc):
+        path = os.path.join(dirname, f"manifest_p{p}.json")
+        if not os.path.exists(path):
+            raise FileNotFoundError(
+                f"sharded save: manifest for process {p}/{nproc} missing "
+                f"({path}) — did the save barrier run on every process?"
+            )
+        with open(path) as f:
+            other = json.load(f)
+        for var, info in other["vars"].items():
+            if info.get("kind") == "sharded":
+                mine = merged["vars"].setdefault(var, info)
+                if mine is not info:
+                    mine["shards"].extend(info["shards"])
+    with open(os.path.join(dirname, SHARDED_META), "w") as f:
+        json.dump(merged, f)
+
+
+def load_sharded_checkpoint(
+    dirname: str,
+    main_program: Optional[Program] = None,
+    scope: Optional[Scope] = None,
+) -> List[str]:
+    """Assemble global host arrays from the shard files and set them into
+    the scope (re-sharding onto a mesh happens on the next parallel run)."""
+    scope = scope or global_scope()
+    with open(os.path.join(dirname, SHARDED_META)) as f:
+        meta = json.load(f)
+    # open only files the manifest references (a reused directory may
+    # hold stale shards_pK.npz from an older, larger job)
+    procs = {0} | {
+        e["process"]
+        for info in meta["vars"].values() if info["kind"] == "sharded"
+        for e in info["shards"]
+    }
+    files = {
+        p: np.load(os.path.join(dirname, f"shards_p{p}.npz")) for p in procs
+    }
+    loaded = []
+    try:
+        for var, info in meta["vars"].items():
+            if info["kind"] == "replicated":
+                scope.set(var, files[0][f"{var}::r"])
+            else:
+                out = np.zeros(info["shape"], np.dtype(info["dtype"]))
+                covered = np.zeros(info["shape"], bool)
+                for e in info["shards"]:
+                    sl = tuple(slice(a, b) for a, b in e["slice"])
+                    out[sl] = files[e["process"]][e["key"]]
+                    covered[sl] = True
+                if not covered.all():
+                    raise ValueError(
+                        f"sharded checkpoint: {var} has uncovered slices "
+                        f"({int((~covered).sum())} of {covered.size} "
+                        "elements) — incomplete save?"
+                    )
+                scope.set(var, out)
+            loaded.append(var)
+    finally:
+        for f in files.values():
+            f.close()
+    return loaded

@@ -19,7 +19,72 @@ from ..initializer import XavierInitializer
 from ..param_attr import ParamAttr
 from .helper import LayerHelper
 
-__all__ = ["attention_gru_decoder", "attention_gru_beam_search"]
+__all__ = ["attention_gru_decoder", "attention_gru_beam_search",
+           "multi_head_attention"]
+
+
+def multi_head_attention(
+    query,
+    key=None,
+    value=None,
+    num_heads: int = 8,
+    causal: bool = True,
+    param_attr=None,
+    bias_attr=None,
+    name=None,
+):
+    """Transformer multi-head attention over dense [B, T, E] inputs
+    (self-attention when key/value are None). Beyond the 2017 reference's
+    layer set — the modern long-context workhorse; compute routes through
+    the flash-attention dispatcher (ops/flash_ops.py: fused O(T)-memory
+    Pallas kernel on TPU, jnp reference elsewhere). Q/K/V/O projections
+    are `fc` layers so AMP/sharding apply as everywhere else."""
+    from .nn import fc
+
+    is_cross = key is not None or value is not None
+    if is_cross and causal:
+        # a square start-aligned causal mask is meaningless when Tq != Tk;
+        # silent acceptance would make encoder-decoder models quietly
+        # ignore most of the source sequence
+        raise ValueError(
+            "causal=True is only valid for self-attention; pass "
+            "causal=False for cross-attention"
+        )
+    key = query if key is None else key
+    value = query if value is None else value
+    helper = LayerHelper("multi_head_attention", name=name)
+    E = int(query.shape[-1])
+    if E % num_heads:
+        raise ValueError(f"hidden dim {E} not divisible by {num_heads} heads")
+
+    import dataclasses
+
+    def _derive(attr, s):
+        """Per-projection attr: keep every field of a caller-supplied
+        ParamAttr but derive a distinct name — passing it through
+        unchanged would tie wq/wk/wv/wo into ONE shared parameter."""
+        if attr is None:
+            return ParamAttr(name=f"{helper.name}.{s}")
+        if attr is False:
+            return False
+        attr = ParamAttr.to_attr(attr)
+        base = attr.name or helper.name
+        return dataclasses.replace(attr, name=f"{base}.{s}")
+
+    proj = lambda x, s: fc(x, size=E, num_flatten_dims=2,
+                           param_attr=_derive(param_attr, s),
+                           bias_attr=_derive(bias_attr, f"{s}_b"))
+    q, k, v = proj(query, "wq"), proj(key, "wk"), proj(value, "wv")
+    out = helper.create_tmp_variable(query.dtype, query.shape)
+    helper.append_op(
+        type="flash_attention",
+        inputs={"Q": [q], "K": [k], "V": [v]},
+        outputs={"Out": [out]},
+        attrs={"num_heads": num_heads, "causal": causal},
+    )
+    return fc(out, size=E, num_flatten_dims=2,
+              param_attr=_derive(param_attr, "wo"),
+              bias_attr=_derive(bias_attr, "wo_b"))
 
 
 def _decoder_params(helper, ctx_dim, emb_dim, hidden, att_size):

@@ -11,3 +11,4 @@ from .image import (  # noqa: F401
 )
 from .seq2seq import seq2seq_attention, seq2seq_beam_decode  # noqa: F401
 from .text import lstm_benchmark_net, stacked_lstm_net, word2vec_net  # noqa: F401
+from .transformer import transformer_lm  # noqa: F401

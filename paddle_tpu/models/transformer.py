@@ -1,0 +1,82 @@
+"""Decoder-only transformer LM — the modern long-context model family.
+
+Beyond the 2017 reference's zoo (it predates transformers); included
+because long context is first-class here: attention routes through the
+flash dispatcher (ops/flash_ops.py — fused O(T)-memory Pallas kernel on
+TPU), pre-LN blocks, learned positional embeddings, gelu FFN. Built
+entirely from the layer DSL so AMP (bf16 activations), remat, Trainer,
+checkpointing and mesh sharding apply unchanged.
+
+transformer_lm: tokens [B, T] int32 → logits [B, T, vocab]. Labels for
+the causal LM loss are the inputs shifted left (caller-side, like the
+seq2seq teacher-forcing convention in models/seq2seq.py).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import paddle_tpu.layers as layers
+from ..initializer import NormalInitializer
+from ..layers.helper import LayerHelper
+from ..param_attr import ParamAttr
+
+__all__ = ["transformer_lm"]
+
+
+def _block(x, num_heads, ffn_dim, prefix, dropout_prob, is_test):
+    """Pre-LN transformer block: x + MHA(LN(x)); x + FFN(LN(x))."""
+    h = layers.layer_norm(x, begin_norm_axis=2, name=f"{prefix}.ln1")
+    h = layers.multi_head_attention(
+        h, num_heads=num_heads, causal=True, name=f"{prefix}.attn"
+    )
+    if dropout_prob and not is_test:
+        h = layers.dropout(h, dropout_prob)
+    x = layers.elementwise_add(x, h)
+    h = layers.layer_norm(x, begin_norm_axis=2, name=f"{prefix}.ln2")
+    h = layers.fc(h, size=ffn_dim, num_flatten_dims=2, act="gelu",
+                  param_attr=ParamAttr(name=f"{prefix}.ffn_in"))
+    h = layers.fc(h, size=int(x.shape[-1]), num_flatten_dims=2,
+                  param_attr=ParamAttr(name=f"{prefix}.ffn_out"))
+    if dropout_prob and not is_test:
+        h = layers.dropout(h, dropout_prob)
+    return layers.elementwise_add(x, h)
+
+
+def transformer_lm(
+    tokens,
+    vocab_size: int,
+    dim: int = 512,
+    num_heads: int = 8,
+    num_layers: int = 6,
+    ffn_dim: int = None,
+    max_len: int = 1024,
+    dropout_prob: float = 0.0,
+    is_test: bool = False,
+    name: str = "tfm",
+):
+    """tokens: dense [B, T] int32 Variable (T <= max_len, static per
+    bucket). Returns per-position logits [B, T, vocab_size]."""
+    ffn_dim = ffn_dim or 4 * dim
+    T = int(tokens.shape[1])
+    if T > max_len:
+        raise ValueError(f"sequence length {T} exceeds max_len {max_len}")
+    x = layers.embedding(
+        tokens, size=[vocab_size, dim],
+        param_attr=ParamAttr(name=f"{name}.tok_emb"),
+    )
+    # learned positional table, sliced to T and broadcast over the batch
+    helper = LayerHelper(name)
+    pos_table = helper.create_parameter(
+        ParamAttr(name=f"{name}.pos_emb"), (max_len, dim),
+        default_initializer=NormalInitializer(0.0, 0.01),
+    )
+    pos = layers.crop(pos_table, offsets=(0, 0), shape=(T, dim))
+    x = layers.elementwise_add(x, pos)
+    for i in range(num_layers):
+        x = _block(x, num_heads, ffn_dim, f"{name}.h{i}", dropout_prob,
+                   is_test)
+    x = layers.layer_norm(x, begin_norm_axis=2, name=f"{name}.ln_f")
+    return layers.fc(x, size=vocab_size, num_flatten_dims=2,
+                     param_attr=ParamAttr(name=f"{name}.out_w"),
+                     bias_attr=False)

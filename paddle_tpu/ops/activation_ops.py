@@ -70,6 +70,9 @@ _ACTIVATIONS = {
         a.get("slope", 0.2) * x + a.get("offset", 0.5), 0.0, 1.0
     ),
     "swish": lambda x, a: x * jax.nn.sigmoid(a.get("beta", 1.0) * x),
+    # gelu (tanh approximation, the transformer default; beyond the
+    # reference's 2017 set — added with models/transformer.py)
+    "gelu": lambda x, a: jax.nn.gelu(x, approximate=True),
 }
 
 

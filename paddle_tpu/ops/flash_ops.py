@@ -27,6 +27,8 @@ import math
 import jax
 import jax.numpy as jnp
 
+from ..core.registry import register_op
+
 NEG_INF = -1e30  # large-finite mask fill (inf would NaN the softmax grads)
 
 
@@ -56,20 +58,32 @@ def _shapes_flash_ok(q, k) -> bool:
     return Tq % 128 == 0 and Tk % 128 == 0 and Dq in (64, 128, 256)
 
 
+# route to the kernel once the XLA formulation's [B, H, Tq, Tk] score
+# buffer would be painful: measured on v5e (PERF.md) XLA's fused unflashed
+# attention is FASTER fwd+bwd while its scores fit comfortably (0.64-0.86x
+# flash/xla at <=1 GB), and stops compiling outright around several GB —
+# the kernel's O(T) memory is a capability, not a shortcut
+_SCORE_BYTES_THRESHOLD = 1.5e9
+
+
+def _prefers_flash(q, k) -> bool:
+    B, Tq, H, _ = q.shape
+    Tk = k.shape[1]
+    return B * H * Tq * Tk * 2 > _SCORE_BYTES_THRESHOLD
+
+
 def flash_eligible(q, k=None) -> bool:
-    return jax.default_backend() == "tpu" and _shapes_flash_ok(
-        q, q if k is None else k
+    k = q if k is None else k
+    return (
+        jax.default_backend() == "tpu"
+        and _shapes_flash_ok(q, k)
+        and _prefers_flash(q, k)
     )
 
 
-def flash_attention(q, k, v, causal: bool = False):
-    """[B, T, H, D] attention; fused TPU kernel when eligible, else the
-    jnp reference. Numerics: bf16 io with f32 online-softmax accumulation
-    inside the kernel (matches the reference formulation to bf16 eps)."""
-    if q.ndim != 4:
-        raise ValueError(f"expected [B, T, H, D], got {q.shape}")
-    if not flash_eligible(q, k):
-        return _reference(q, k, v, causal)
+def _flash_kernel(q, k, v, causal: bool):
+    """Direct fused-kernel call, no dispatch gate (benchmarks and the
+    eligible path both come through here)."""
     from jax.experimental.pallas.ops.tpu.flash_attention import (
         flash_attention as _tpu_flash,
     )
@@ -80,3 +94,33 @@ def flash_attention(q, k, v, causal: bool = False):
         sm_scale=float(1.0 / math.sqrt(q.shape[-1])),
     )
     return jnp.transpose(o, (0, 2, 1, 3))
+
+
+def flash_attention(q, k, v, causal: bool = False):
+    """[B, T, H, D] attention; fused O(T)-memory TPU kernel for long
+    sequences, jnp reference otherwise (XLA's attention is faster while
+    its score matrix fits — the kernel takes over where XLA cannot go).
+    Numerics: bf16 io with f32 online-softmax accumulation inside the
+    kernel (matches the reference formulation to bf16 eps)."""
+    if q.ndim != 4:
+        raise ValueError(f"expected [B, T, H, D], got {q.shape}")
+    if not flash_eligible(q, k):
+        return _reference(q, k, v, causal)
+    return _flash_kernel(q, k, v, causal)
+
+
+@register_op("flash_attention")
+def flash_attention_kernel(ctx):
+    """Program-IR face of the dispatcher: Q/K/V are [B, T, E] packed
+    multi-head projections; num_heads splits E. Used by
+    layers.multi_head_attention (models/transformer.py)."""
+    q, k, v = ctx.input("Q"), ctx.input("K"), ctx.input("V")
+    heads = ctx.attr("num_heads")
+    causal = ctx.attr("causal", True)
+    B, T, E = q.shape
+    if E % heads:
+        raise ValueError(f"hidden dim {E} not divisible by heads {heads}")
+    D = E // heads
+    split = lambda x: x.reshape(B, x.shape[1], heads, D)  # noqa: E731
+    o = flash_attention(split(q), split(k), split(v), causal=causal)
+    ctx.set_output("Out", o.reshape(B, T, E))

@@ -69,6 +69,15 @@ def test_eligibility_rules():
     assert not _shapes_flash_ok(jnp.zeros((1, 256, 2, 48)), ok)   # head dim
     assert not flash_eligible(ok)  # CPU backend gate
 
+    # memory-threshold routing (PERF.md: XLA attention is FASTER while
+    # its score buffer fits; the kernel takes over past ~1.5 GB)
+    from paddle_tpu.ops.flash_ops import _prefers_flash
+
+    small = jnp.zeros((2, 2048, 8, 128))   # scores ~134 MB → XLA
+    big = jnp.zeros((1, 32768, 4, 128))    # scores ~8.6 GB → kernel
+    assert not _prefers_flash(small, small)
+    assert _prefers_flash(big, big)
+
 
 def test_ulysses_uses_flash_dispatch_path():
     """Ulysses routes local attention through flash_attention; on the CPU

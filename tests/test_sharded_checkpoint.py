@@ -1,0 +1,134 @@
+"""Sharded (orbax-style) checkpoint tests on the 8-device CPU mesh.
+
+Reference parity: the pserver's parameter-block persistence
+(go/pserver/service.go:346 checkpoint with CRC + etcd pointer;
+`loadsave_parameters_in_pserver`, utils/Flags.cpp:77) — here each process
+writes only the shards it owns, so saving a ZeRO-sharded optimizer state
+or an mp-sharded table never all-gathers (SURVEY §5.4).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+from jax.sharding import PartitionSpec
+
+import paddle_tpu as pt
+from paddle_tpu import io as pio
+from paddle_tpu import parallel as pp
+
+
+@pytest.fixture
+def mesh42():
+    assert len(jax.devices()) == 8
+    return pp.make_mesh((4, 2), ("dp", "mp"))
+
+
+def _build():
+    x = pt.layers.data("x", shape=[16])
+    y = pt.layers.data("y", shape=[1])
+    h = pt.layers.fc(x, size=64, act="relu",
+                     param_attr=pt.ParamAttr(name="w1"), bias_attr=False)
+    pred = pt.layers.fc(h, size=1, param_attr=pt.ParamAttr(name="w2"),
+                        bias_attr=False)
+    loss = pt.layers.mean(pt.layers.square_error_cost(pred, y))
+    pt.optimizer.Adam(learning_rate=0.05).minimize(loss)
+    gb = pt.default_main_program().global_block()
+    gb.var("w1").sharding = PartitionSpec(None, "mp")  # mp-sharded layer
+    return loss
+
+
+def _feed(step):
+    rng = np.random.RandomState(step)
+    return {"x": rng.randn(16, 16).astype(np.float32),
+            "y": rng.randn(16, 1).astype(np.float32)}
+
+
+def _train(exe, prog, loss, steps, start=0):
+    out = []
+    for s in range(start, start + steps):
+        (l,) = exe.run(prog, feed=_feed(s), fetch_list=[loss])
+        out.append(float(l))
+    return out
+
+
+def test_sharded_checkpoint_resume_matches_uninterrupted(tmp_path, mesh42):
+    def fresh():
+        pt.reset()
+        loss = _build()
+        prog = pt.default_main_program()
+        prog.random_seed = 3
+        pt.default_startup_program().random_seed = 3
+        exe = pp.ParallelExecutor(mesh42, shard_optimizer_state=True)
+        pt.Executor().run(pt.default_startup_program())
+        return exe, prog, loss
+
+    # uninterrupted 4 steps
+    exe, prog, loss = fresh()
+    ref = _train(exe, prog, loss, 4)
+
+    # 2 steps → sharded save → wipe scope → restore → 2 more steps
+    exe, prog, loss = fresh()
+    a = _train(exe, prog, loss, 2)
+    d = str(tmp_path / "ckpt")
+    pio.save_sharded_checkpoint(d, prog)
+
+    # the save wrote only unique shards: the ZeRO-sharded adam moments
+    # must appear as "sharded" entries in the manifest
+    import json
+    import os
+
+    with open(os.path.join(d, pio.SHARDED_META)) as f:
+        meta = json.load(f)
+    kinds = {v["kind"] for v in meta["vars"].values()}
+    assert "sharded" in kinds and "replicated" in kinds
+    sharded_vars = [n for n, v in meta["vars"].items() if v["kind"] == "sharded"]
+    assert any("moment" in n.lower() or "w1" in n for n in sharded_vars), sharded_vars
+
+    pt.reset_global_scope()
+    restored = pio.load_sharded_checkpoint(d, prog)
+    assert "w1" in restored and "w2" in restored
+    b = _train(exe, prog, loss, 2, start=2)
+    np.testing.assert_allclose(a + b, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_serial_checkpoint_sharded_mode_autodetects(tmp_path, mesh42):
+    """save_checkpoint(sharded=True) + load_checkpoint: the serial layer
+    (cadence, latest-pointer, trainer_args) rides on the sharded format
+    and the loader auto-detects it."""
+    pt.reset()
+    loss = _build()
+    prog = pt.default_main_program()
+    exe = pp.ParallelExecutor(mesh42, shard_optimizer_state=True)
+    pt.Executor().run(pt.default_startup_program())
+    _train(exe, prog, loss, 1)
+    w1 = np.asarray(pt.global_scope().get("w1")).copy()
+    d = str(tmp_path / "serial")
+    serial = pio.save_checkpoint(d, {"pass": 1, "batch": 7}, prog,
+                                 sharded=True)
+    assert serial == 0
+    pt.reset_global_scope()
+    args = pio.load_checkpoint(d, prog)
+    assert args == {"pass": 1, "batch": 7}
+    np.testing.assert_allclose(np.asarray(pt.global_scope().get("w1")), w1)
+
+
+def test_sharded_checkpoint_roundtrip_values(tmp_path, mesh42):
+    """Every persistable survives the shard/assemble round-trip exactly."""
+    pt.reset()
+    loss = _build()
+    prog = pt.default_main_program()
+    exe = pp.ParallelExecutor(mesh42, shard_optimizer_state=True)
+    pt.Executor().run(pt.default_startup_program())
+    _train(exe, prog, loss, 1)
+    before = {
+        v.name: np.asarray(pt.global_scope().get(v.name)).copy()
+        for v in prog.persistables() if pt.global_scope().has(v.name)
+    }
+    d = str(tmp_path / "ckpt")
+    pio.save_sharded_checkpoint(d, prog)
+    pt.reset_global_scope()
+    pio.load_sharded_checkpoint(d, prog)
+    for n, want in before.items():
+        got = np.asarray(pt.global_scope().get(n))
+        np.testing.assert_allclose(got, want, rtol=0, atol=0, err_msg=n)
