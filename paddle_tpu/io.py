@@ -272,15 +272,44 @@ def save_checkpoint(
     writes only shards it owns — no all-gather; see the sharded section
     below) instead of the single gathered npz."""
     serial = get_latest_checkpoint_serial(checkpoint_dir) + 1
-    d = _serial_dir(checkpoint_dir, serial)
-    os.makedirs(d, exist_ok=True)
     if sharded:
-        save_sharded_checkpoint(d, main_program, scope)
+        import jax
+
+        chief = jax.process_index() == 0
+        if jax.process_count() > 1:
+            # every process must agree on the serial: re-deriving it from
+            # an unsynchronized filesystem listing can split one save
+            # across two serial directories — the chief decides
+            from jax.experimental import multihost_utils
+
+            serial = int(
+                multihost_utils.broadcast_one_to_all(np.int32(serial))
+            )
+        d = _serial_dir(checkpoint_dir, serial)
+        os.makedirs(d, exist_ok=True)
+        save_sharded_checkpoint(d, main_program, scope)  # barriers inside
+        # completion marker: chief only, AFTER the fold, then a barrier so
+        # no process returns before the checkpoint is actually loadable
+        if chief:
+            with open(os.path.join(d, META_FILE), "w") as f:
+                json.dump(
+                    {"serial": serial, "trainer_args": trainer_args or {}}, f
+                )
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+
+            multihost_utils.sync_global_devices("ptpu_ckpt_meta")
+        if not chief:
+            return serial
     else:
+        d = _serial_dir(checkpoint_dir, serial)
+        os.makedirs(d, exist_ok=True)
         save_persistables(d, main_program, scope)
-    # meta written last: its presence marks the checkpoint complete
-    with open(os.path.join(d, META_FILE), "w") as f:
-        json.dump({"serial": serial, "trainer_args": trainer_args or {}}, f)
+        # meta written last: its presence marks the checkpoint complete
+        with open(os.path.join(d, META_FILE), "w") as f:
+            json.dump(
+                {"serial": serial, "trainer_args": trainer_args or {}}, f
+            )
     serials = sorted(
         int(m.group(1))
         for name in os.listdir(checkpoint_dir)
@@ -417,6 +446,12 @@ def save_sharded_checkpoint(
         multihost_utils.sync_global_devices("ptpu_sharded_ckpt_save")
     if pid == 0:
         _fold_sharded_manifests(dirname, meta)
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        # nobody leaves before sharded_meta.json exists — a caller (e.g.
+        # save_checkpoint) must be able to treat the dir as loadable
+        multihost_utils.sync_global_devices("ptpu_sharded_ckpt_fold")
     return dirname
 
 
@@ -455,6 +490,11 @@ def load_sharded_checkpoint(
     scope = scope or global_scope()
     with open(os.path.join(dirname, SHARDED_META)) as f:
         meta = json.load(f)
+    if main_program is not None:
+        # match the single-file path's semantics: touch only the
+        # program's persistables, not every name the manifest carries
+        keep = {v.name for v in main_program.persistables()}
+        meta["vars"] = {n: i for n, i in meta["vars"].items() if n in keep}
     # open only files the manifest references (a reused directory may
     # hold stale shards_pK.npz from an older, larger job)
     procs = {0} | {

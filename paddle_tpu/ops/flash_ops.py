@@ -67,9 +67,14 @@ _SCORE_BYTES_THRESHOLD = 1.5e9
 
 
 def _prefers_flash(q, k) -> bool:
+    import numpy as np
+
     B, Tq, H, _ = q.shape
     Tk = k.shape[1]
-    return B * H * Tq * Tk * 2 > _SCORE_BYTES_THRESHOLD
+    # scores inherit the input dtype in the reference formulation: f32
+    # inputs double the buffer vs bf16
+    itemsize = np.dtype(q.dtype).itemsize
+    return B * H * Tq * Tk * itemsize > _SCORE_BYTES_THRESHOLD
 
 
 def flash_eligible(q, k=None) -> bool:

@@ -57,7 +57,7 @@ def lstm_supported(B: int, H: int, gate_act, cell_act, cand_act, peep) -> bool:
         and B % 8 == 0
         and H % 128 == 0
         # measured window (benchmarks/lstm_kernel_microbench.json): the
-        # fused train recurrence beats lax.scan at H>=512 (1.1-1.6x) but
+        # fused train recurrence beats lax.scan at H>=384 (1.1-1.6x) but
         # loses at H=256 (0.86x — the per-step matmul is too small to
         # amortize the kernel's fixed work); upper bound: the backward
         # kernel's f32 dW accumulator ([H, 4H] = 16H² bytes) must fit
