@@ -14,12 +14,14 @@ TPU-preferred layout).
 
 from __future__ import annotations
 
+import os
+
 import numpy as np
 
 __all__ = [
     "load_image", "load_image_bytes", "resize_short", "to_chw",
     "center_crop", "random_crop", "left_right_flip", "simple_transform",
-    "load_and_transform",
+    "load_and_transform", "batch_images_from_tar", "batch_reader",
 ]
 
 
@@ -145,3 +147,76 @@ def load_and_transform(
         load_image(filename, is_color), resize_size, crop_size, is_train,
         is_color, mean,
     )
+
+
+def batch_images_from_tar(
+    data_file: str,
+    dataset_name: str,
+    img2label: dict,
+    num_per_batch: int = 1024,
+) -> str:
+    """Pre-batch a tar of images into batch files + a meta list.
+
+    Reference: python/paddle/v2/image.py:48-109 (same contract: returns
+    the meta file path listing batch files, in tar order; idempotent once
+    complete). Batch files are .npz holding the encoded image bytes as
+    one flat uint8 buffer + offsets (NOT an object array — object arrays
+    make numpy pickle internally and re-open the reference's
+    pickle-on-load code-execution hole). The meta file is written LAST:
+    its presence marks the batching complete, so an interrupted run
+    restarts instead of returning a half-written set. Read back with
+    `batch_reader(meta_file)`.
+    """
+    import tarfile
+
+    batch_dir = data_file + "_batch"
+    out_path = os.path.join(batch_dir, dataset_name)
+    meta_file = os.path.join(batch_dir, f"{dataset_name}.txt")
+    if os.path.exists(meta_file):  # completion marker, not the dir
+        return meta_file
+    os.makedirs(out_path, exist_ok=True)
+
+    paths: list = []
+
+    def dump(data, labels, file_id):
+        buf = np.frombuffer(b"".join(data), dtype=np.uint8)
+        offsets = np.cumsum([0] + [len(d) for d in data]).astype(np.int64)
+        p = os.path.join(out_path, f"batch_{file_id}.npz")
+        np.savez(p, data=buf, offsets=offsets, label=np.asarray(labels))
+        paths.append(os.path.abspath(p))
+
+    data, labels, file_id = [], [], 0
+    with tarfile.open(data_file) as tf:
+        for mem in tf.getmembers():
+            if mem.name not in img2label:
+                continue
+            data.append(tf.extractfile(mem).read())
+            labels.append(img2label[mem.name])
+            if len(data) == num_per_batch:
+                dump(data, labels, file_id)
+                file_id += 1
+                data, labels = [], []
+    if data:
+        dump(data, labels, file_id)
+    # written in production order (no listdir re-scan: lexicographic
+    # order would interleave batch_10 between batch_1 and batch_2)
+    with open(meta_file, "w") as meta:
+        meta.write("".join(p + "\n" for p in paths))
+    return meta_file
+
+
+def batch_reader(meta_file: str, is_color: bool = True):
+    """Reader over batch files produced by batch_images_from_tar:
+    yields (decoded HWC image, label) samples in tar order."""
+
+    def reader():
+        with open(meta_file) as f:
+            paths = [ln.strip() for ln in f if ln.strip()]
+        for p in paths:
+            with np.load(p) as d:  # no allow_pickle: plain arrays only
+                buf, offsets = d["data"], d["offsets"]
+                for j, label in enumerate(d["label"]):
+                    raw = buf[offsets[j]:offsets[j + 1]].tobytes()
+                    yield load_image_bytes(raw, is_color), label
+
+    return reader

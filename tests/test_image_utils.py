@@ -64,6 +64,37 @@ def test_simple_transform_train_and_test():
     np.testing.assert_allclose(te0[2] - 3.0, te[2], atol=1e-5)
 
 
+def test_batch_images_from_tar_roundtrip(tmp_path):
+    """Reference image.py:48-109 contract: tar → batch files + meta list,
+    idempotent; batch_reader yields decoded (image, label) samples."""
+    import tarfile
+
+    from PIL import Image
+
+    tar_path = str(tmp_path / "imgs.tar")
+    imgs = {}
+    with tarfile.open(tar_path, "w") as tf:
+        for i in range(5):
+            im = _im(6, 6)
+            imgs[f"img_{i}.png"] = im
+            buf = io.BytesIO()
+            Image.fromarray(im).save(buf, format="PNG")
+            buf.seek(0)
+            info = tarfile.TarInfo(f"img_{i}.png")
+            info.size = len(buf.getvalue())
+            tf.addfile(info, buf)
+    img2label = {f"img_{i}.png": i % 2 for i in range(5)}
+    meta = pimg.batch_images_from_tar(tar_path, "train", img2label,
+                                      num_per_batch=2)
+    assert meta == pimg.batch_images_from_tar(tar_path, "train", img2label)
+    samples = list(pimg.batch_reader(meta)())
+    assert len(samples) == 5
+    labels = sorted(int(lbl) for _, lbl in samples)
+    assert labels == [0, 0, 0, 1, 1]
+    for im, _ in samples:
+        assert im.shape == (6, 6, 3)
+
+
 def test_load_image_bytes_roundtrip():
     from PIL import Image
 
