@@ -199,9 +199,18 @@ def batch_images_from_tar(
     if data:
         dump(data, labels, file_id)
     # written in production order (no listdir re-scan: lexicographic
-    # order would interleave batch_10 between batch_1 and batch_2)
-    with open(meta_file, "w") as meta:
-        meta.write("".join(p + "\n" for p in paths))
+    # order would interleave batch_10 between batch_1 and batch_2) and
+    # atomically — a truncated meta would otherwise read as "complete"
+    import tempfile
+
+    fd, tmp = tempfile.mkstemp(dir=batch_dir, suffix=".tmp")
+    try:
+        with os.fdopen(fd, "w") as meta:
+            meta.write("".join(p + "\n" for p in paths))
+        os.replace(tmp, meta_file)
+    finally:
+        if os.path.exists(tmp):
+            os.remove(tmp)
     return meta_file
 
 

@@ -73,6 +73,11 @@ def gru_supported(B: int, H: int, gate_act, cand_act) -> bool:
         and cand_act == "tanh"
         and B % 8 == 0
         and H % 128 == 0
+        # measured window (benchmarks/lstm_kernel_microbench.json "gru"
+        # rows): only the fused GRU forward exists (its backward re-runs
+        # the scan under jax.vjp), so the win is narrower than the
+        # LSTM's — 1.24x at H=256, ties at 384, loses at 128 and 512
+        and 256 <= H <= 384
         and _backend_ok()
     )
 
