@@ -1,0 +1,106 @@
+"""Microbench: fused LSTM **and GRU** (fwd+bwd) vs lax.scan across H, real TPU.
+
+Round 3: the GRU now has a hand-written reverse-time backward kernel and
+both cells have an outer-einsum dW path past H=640, so the eligibility
+windows must be re-measured — including the NMT config (H=512) and the
+reference's largest published config (H=1280,
+/root/reference/benchmark/README.md:129-136).
+
+Writes benchmarks/rnn_kernel_microbench.json. Timing per PERF.md: chained
+in-jit reps, DCE-proof grad consumption, single d2h scalar readback.
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.ops import pallas_kernels
+from paddle_tpu.ops.rnn_ops import gru_scan, lstm_scan
+
+
+def timeit(f, *args):
+    r = f(*args)
+    np.asarray(jax.tree.leaves(r)[0].ravel()[0])
+    t0 = time.perf_counter()
+    r = f(*args)
+    np.asarray(jax.tree.leaves(r)[0].ravel()[0])
+    return time.perf_counter() - t0
+
+
+def bench(cell, T, B, H, dtype, reps=30):
+    rng = np.random.RandomState(0)
+    G = 4 if cell == "lstm" else 3
+    x = jnp.asarray(rng.randn(T, B, G * H) * 0.1, dtype)
+    w = jnp.asarray(rng.randn(H, G * H) * 0.05, dtype)
+    mask = jnp.ones((T, B), jnp.float32)
+
+    def many(core):
+        def loss(x, w):
+            out = core(x, mask, w)
+            hT = out[1][0] if cell == "lstm" else out[1]
+            return jnp.sum(hT.astype(jnp.float32))
+
+        @jax.jit
+        def run(x, w):
+            def body(carry, _):
+                x, w = carry
+                l, (dx, dw) = jax.value_and_grad(loss, argnums=(0, 1))(x, w)
+                eps = jnp.asarray(1e-12, x.dtype)  # DCE-proof (PERF.md)
+                return (x + eps * dx, w + eps * dw), l
+            (x, w), ls = jax.lax.scan(body, (x, w), None, length=reps)
+            return ls[-1]
+        return run
+
+    if cell == "lstm":
+        scan_core = lambda x, m, w: lstm_scan(x, m, w, None)  # noqa: E731
+        fused_core = lambda x, m, w: pallas_kernels.lstm_fused(x, m, w)  # noqa: E731
+    else:
+        scan_core = lambda x, m, w: gru_scan(x, m, w, None)  # noqa: E731
+        fused_core = lambda x, m, w: pallas_kernels.gru_fused(x, m, w)  # noqa: E731
+
+    row = {"cell": cell, "T": T, "B": B, "H": H, "dtype": dtype.__name__}
+    try:
+        t_fused = timeit(many(fused_core), x, w) / reps
+    except Exception as e:  # noqa: BLE001 — record compile failures as data
+        row["fused_error"] = str(e).split("\n")[0][:200]
+        t_fused = None
+    t_scan = timeit(many(scan_core), x, w) / reps
+    flops = 3 * 2 * T * B * H * G * H
+    row["scan_ms"] = round(t_scan * 1e3, 3)
+    if t_fused:
+        row.update(
+            fused_ms=round(t_fused * 1e3, 3),
+            speedup=round(t_scan / t_fused, 3),
+            fused_tflops=round(flops / t_fused / 1e12, 2),
+        )
+    print(row, flush=True)
+    return row
+
+
+if __name__ == "__main__":
+    rows = []
+    for H in (128, 256, 384, 512, 640, 768, 1024, 1280):
+        rows.append(bench("gru", 100, 128, H, jnp.bfloat16))
+    for H in (512, 768, 1024, 1280):
+        rows.append(bench("lstm", 100, 128, H, jnp.bfloat16))
+    # the reference's largest published LSTM config: h=1280 bs=256
+    rows.append(bench("lstm", 100, 256, 1280, jnp.bfloat16))
+    out = {
+        "bench": "fused recurrence (fwd+bwd, hand-written bwd kernels) vs "
+                 "lax.scan, one chip",
+        "device": str(jax.devices()[0].device_kind),
+        "method": "chained in-jit reps, single d2h readback, DCE-proof",
+        "rows": rows,
+    }
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "benchmarks",
+        "rnn_kernel_microbench.json")
+    with open(path, "w") as f:
+        json.dump(out, f, indent=1)
+    print("wrote", path)

@@ -48,7 +48,30 @@ def _backend_ok() -> bool:
     return jax.default_backend() == "tpu" or FLAGS.fused_rnn_interpret
 
 
-def lstm_supported(B: int, H: int, gate_act, cell_act, cand_act, peep) -> bool:
+# The backward kernel's VMEM working set must fit the 16M scoped budget;
+# the model below reproduces every measured compile outcome: LSTM bf16
+# H=1280 B=128 → 18.7M predicted vs 18.75M in the observed train-graph
+# overflow; GRU f32 H=1280 B=128 → 25.6M vs observed 25.0M overflow;
+# LSTM bf16 H=1280 B=256 → 24.2M vs the microbench fused_error row;
+# GRU bf16 H=1280 B=128 → 14.7M, compiles and wins 1.88x
+# (benchmarks/rnn_kernel_microbench.json).
+_VMEM_BUDGET = 16 * 1024 * 1024
+
+
+def _bwd_vmem_bytes(B: int, H: int, G: int, itemsize: int,
+                    dw_max_h: int) -> int:
+    """G = gates per cell (4 LSTM, 3 GRU); itemsize = io dtype bytes;
+    dw_max_h = that cell's fused-dW threshold (the model must track the
+    kernel's actual fuse decision)."""
+    weight_block = G * H * H * itemsize
+    io_blocks = 2 * (G + 3) * B * H * itemsize  # double-buffered streams
+    carries = 3 * B * H * itemsize
+    dw_acc = 4 * G * H * H if H <= dw_max_h else 0  # f32 accumulator
+    return weight_block + io_blocks + carries + dw_acc
+
+
+def lstm_supported(B: int, H: int, gate_act, cell_act, cand_act, peep,
+                   itemsize: int = 2) -> bool:
     return (
         peep is None
         and gate_act == "sigmoid"
@@ -56,28 +79,36 @@ def lstm_supported(B: int, H: int, gate_act, cell_act, cand_act, peep) -> bool:
         and cand_act == "tanh"
         and B % 8 == 0
         and H % 128 == 0
-        # measured window (benchmarks/lstm_kernel_microbench.json): the
-        # fused train recurrence beats lax.scan at H>=384 (1.1-1.6x) but
-        # loses at H=256 (0.86x — the per-step matmul is too small to
-        # amortize the kernel's fixed work); upper bound: the backward
-        # kernel's f32 dW accumulator ([H, 4H] = 16H² bytes) must fit
-        # scoped VMEM (~16 MB) next to the weight + io blocks
-        and 384 <= H <= 640
+        # measured window (benchmarks/rnn_kernel_microbench.json, round 3
+        # with the outer-einsum dW past H=640): 1.02x at H=512, 1.45x at
+        # 768, 1.60x at 1024, 1.13x at 1280 — the reference's largest
+        # published config (benchmark/README.md:129-136) now eligible at
+        # bf16; H=256 still loses (0.86x, r2 data): the per-step matmul
+        # is too small to amortize the kernel's fixed work
+        and 384 <= H <= 1280
+        and _bwd_vmem_bytes(B, H, 4, itemsize,
+                            _LSTM_FUSED_DW_MAX_H) <= _VMEM_BUDGET
         and _backend_ok()
     )
 
 
-def gru_supported(B: int, H: int, gate_act, cand_act) -> bool:
+def gru_supported(B: int, H: int, gate_act, cand_act,
+                  itemsize: int = 2) -> bool:
     return (
         gate_act == "sigmoid"
         and cand_act == "tanh"
         and B % 8 == 0
         and H % 128 == 0
-        # measured window (benchmarks/lstm_kernel_microbench.json "gru"
-        # rows): only the fused GRU forward exists (its backward re-runs
-        # the scan under jax.vjp), so the win is narrower than the
-        # LSTM's — 1.24x at H=256, ties at 384, loses at 128 and 512
-        and 256 <= H <= 384
+        # measured window (benchmarks/rnn_kernel_microbench.json, round 3
+        # with the hand-written reverse-time backward kernel replacing the
+        # scan-replay VJP): 1.18x at H=128, 1.06x at 256, 1.72x at 512
+        # (the NMT config), 1.70x at 640, 1.24x at 768, 1.61x at 1024,
+        # 1.88x at 1280. H=384 alone dips to 0.86x (3H=1152 tiles badly
+        # against the 512-lane MXU pass) and stays on the scan
+        and 128 <= H <= 1280
+        and H != 384
+        and _bwd_vmem_bytes(B, H, 3, itemsize,
+                           _GRU_FUSED_DW_MAX_H) <= _VMEM_BUDGET
         and _backend_ok()
     )
 
@@ -170,23 +201,33 @@ def _lstm_bwd_kernel(
     dhT_ref,  # (B, H) cotangent of final h
     dcT_ref,  # (B, H) cotangent of final c
     dx_ref,  # out (1, B, 4H)
-    dw_ref,  # out (H, 4H)
+    dw_ref,  # out (H, 4H) — absent when accumulate_dw=False
     dh_s,  # scratch (B, H): dL/dh_t carry
     dc_s,  # scratch (B, H): dL/dc_t carry
-    dw_s,  # scratch (H, 4H) f32 accumulator
+    dw_s,  # scratch (H, 4H) f32 accumulator — absent when accumulate_dw=False
+    *,
+    accumulate_dw: bool = True,
 ):
     """Reverse-time step: t = T-1-s via the index maps. Gates are
 
     recomputed OUTSIDE in one batched matmul (h_seq is saved, so gate
     pre-activations have no sequential dependency); only the dh/dc carry
-    is sequential here."""
+    is sequential here.
+
+    accumulate_dw=False drops the in-VMEM [H, 4H] f32 dW accumulator (16H²
+    bytes — past H=640 it evicts everything else); dW is then one batched
+    einsum over the emitted dgates OUTSIDE the kernel, which only costs one
+    extra HBM read of dx. That lifts the eligibility window to the
+    reference's largest published config (H=1280,
+    /root/reference/benchmark/README.md:129-136)."""
     s = pl.program_id(0)
 
     @pl.when(s == 0)
     def _():
         dh_s[:] = dhT_ref[:]
         dc_s[:] = dcT_ref[:]
-        dw_s[:] = jnp.zeros_like(dw_s)
+        if accumulate_dw:
+            dw_s[:] = jnp.zeros_like(dw_s)
 
     # all gate/cotangent math in f32 (see _lstm_kernel's dtype note)
     gates = gates_ref[0].astype(jnp.float32)
@@ -222,13 +263,32 @@ def _lstm_bwd_kernel(
         + (1 - m) * dh_total
     ).astype(dh_s.dtype)
     dc_s[:] = (dc_raw * f + (1 - m) * dc_total).astype(dc_s.dtype)
-    dw_s[:] = dw_s[:] + jnp.dot(
-        h_prev.T, dgates.astype(dt), preferred_element_type=jnp.float32
+    if accumulate_dw:
+        dw_s[:] = dw_s[:] + jnp.dot(
+            h_prev.T, dgates.astype(dt), preferred_element_type=jnp.float32
+        )
+
+        @pl.when(s == pl.num_programs(0) - 1)
+        def _():
+            dw_ref[:] = dw_s[:].astype(dw_ref.dtype)
+
+
+def _lstm_bwd_kernel_nodw(
+    gates_ref, cprev_ref, hprev_ref, dh_seq_ref, m_ref, w_ref, dhT_ref,
+    dcT_ref, dx_ref, dh_s, dc_s,
+):
+    """Positional-signature adapter: without the dW output/scratch, pallas
+    hands the kernel one fewer ref in each group."""
+    _lstm_bwd_kernel(
+        gates_ref, cprev_ref, hprev_ref, dh_seq_ref, m_ref, w_ref, dhT_ref,
+        dcT_ref, dx_ref, None, dh_s, dc_s, None, accumulate_dw=False,
     )
 
-    @pl.when(s == pl.num_programs(0) - 1)
-    def _():
-        dw_ref[:] = dw_s[:].astype(dw_ref.dtype)
+
+# past this hidden size the [H, 4H] f32 dW accumulator (16H² bytes) no
+# longer fits VMEM next to the weight and io blocks; switch to the outer
+# batched-einsum dW (see _lstm_bwd_kernel docstring)
+_LSTM_FUSED_DW_MAX_H = 640
 
 
 def _lstm_bwd_pallas(x_tbh, mask, w_rec, h_seq, c_seq, dh_seq, dhT, dcT):
@@ -243,9 +303,17 @@ def _lstm_bwd_pallas(x_tbh, mask, w_rec, h_seq, c_seq, dh_seq, dhT, dcT):
         "tbh,hk->tbk", h_prev_seq, w_rec,
         preferred_element_type=jnp.float32,
     ).astype(dt)
+    fuse_dw = H <= _LSTM_FUSED_DW_MAX_H
     rev = lambda t: (T - 1 - t, 0, 0)  # noqa: E731 — reverse-time index map
-    dx, dw = pl.pallas_call(
-        _lstm_bwd_kernel,
+    out_specs = [pl.BlockSpec((1, B, H4), rev)]
+    out_shape = [jax.ShapeDtypeStruct((T, B, H4), dt)]
+    scratch = [pltpu.VMEM((B, H), dt), pltpu.VMEM((B, H), dt)]
+    if fuse_dw:
+        out_specs.append(pl.BlockSpec((H, H4), lambda t: (0, 0)))
+        out_shape.append(jax.ShapeDtypeStruct((H, H4), dt))
+        scratch.append(pltpu.VMEM((H, H4), jnp.float32))
+    outs = pl.pallas_call(
+        _lstm_bwd_kernel if fuse_dw else _lstm_bwd_kernel_nodw,
         grid=(T,),
         in_specs=[
             pl.BlockSpec((1, B, H4), rev),
@@ -257,19 +325,9 @@ def _lstm_bwd_pallas(x_tbh, mask, w_rec, h_seq, c_seq, dh_seq, dhT, dcT):
             pl.BlockSpec((B, H), lambda t: (0, 0)),
             pl.BlockSpec((B, H), lambda t: (0, 0)),
         ],
-        out_specs=[
-            pl.BlockSpec((1, B, H4), rev),
-            pl.BlockSpec((H, H4), lambda t: (0, 0)),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct((T, B, H4), dt),
-            jax.ShapeDtypeStruct((H, H4), dt),
-        ],
-        scratch_shapes=[
-            pltpu.VMEM((B, H), dt),
-            pltpu.VMEM((B, H), dt),
-            pltpu.VMEM((H, H4), jnp.float32),
-        ],
+        out_specs=out_specs,
+        out_shape=out_shape,
+        scratch_shapes=scratch,
         interpret=_interpret(),
     )(
         gates_pre,
@@ -281,6 +339,14 @@ def _lstm_bwd_pallas(x_tbh, mask, w_rec, h_seq, c_seq, dh_seq, dhT, dcT):
         dhT,
         dcT,
     )
+    if fuse_dw:
+        dx, dw = outs
+    else:
+        (dx,) = outs if isinstance(outs, (list, tuple)) else (outs,)
+        dw = jnp.einsum(
+            "tbh,tbk->hk", h_prev_seq, dx,
+            preferred_element_type=jnp.float32,
+        ).astype(dt)
     return dx, dw
 
 
@@ -388,6 +454,164 @@ def _gru_pallas_raw(x_tbh, mask, w_rec):
     )(x_tbh, mask.astype(jnp.float32).reshape(T, 1, B), w_rec)
 
 
+def _gru_bwd_kernel(
+    ur_pre_ref,  # (1, B, 2H) update/reset pre-activations at t
+    c_pre_ref,  # (1, B, H) candidate pre-activation at t
+    hprev_ref,  # (1, B, H) h_{t-1}
+    dh_seq_ref,  # (1, B, H) output cotangent at t
+    m_ref,  # (1, 1, B)
+    w_ref,  # (H, 3H) = [W_u | W_r | W_c]
+    dhT_ref,  # (B, H) cotangent of final h
+    dx_ref,  # out (1, B, 3H)
+    dw_ref,  # out (H, 3H) — absent when accumulate_dw=False
+    dh_s,  # scratch (B, H): dL/dh_t carry
+    dw_s,  # scratch (H, 3H) f32 accumulator — absent when accumulate_dw=False
+    *,
+    accumulate_dw: bool = True,
+):
+    """Reverse-time GRU step (t = T-1-s via the index maps), replacing the
+    round-2 scan-replay VJP. Forward (gru_cell):
+        u = σ(xu + h@Wu);  r = σ(xr + h@Wr);  c = tanh(xc + (r·h)@Wc)
+        h' = (1-u)·h + u·c, masked h' = m·h' + (1-m)·h
+    The pre-activations have no sequential dependency (h_seq is saved) so
+    they are recomputed OUTSIDE in batched matmuls; only the dh carry is
+    sequential. Reference counterpart: hl_gpu_gru.cuh backward."""
+    s = pl.program_id(0)
+
+    @pl.when(s == 0)
+    def _():
+        dh_s[:] = dhT_ref[:]
+        if accumulate_dw:
+            dw_s[:] = jnp.zeros_like(dw_s)
+
+    H = dh_s.shape[-1]
+    ur = jax.nn.sigmoid(ur_pre_ref[0].astype(jnp.float32))
+    u, r = ur[:, :H], ur[:, H:]
+    c = jnp.tanh(c_pre_ref[0].astype(jnp.float32))
+    h_prev = hprev_ref[0]
+    h_prev32 = h_prev.astype(jnp.float32)
+    m = m_ref[0, 0][:, None]
+
+    dh_total = dh_seq_ref[0].astype(jnp.float32) + dh_s[:].astype(jnp.float32)
+    dh_raw = m * dh_total
+    dc_act = dh_raw * u
+    du_act = dh_raw * (c - h_prev32)
+    dh_prev = (1 - m) * dh_total + dh_raw * (1 - u)
+
+    dc_pre = dc_act * (1 - c * c)
+    dt = dx_ref.dtype
+    w_c = w_ref[:, 2 * H:]
+    drh = jnp.dot(
+        dc_pre.astype(dt), w_c.T, preferred_element_type=jnp.float32
+    )  # cotangent of (r·h_prev)
+    dr_act = drh * h_prev32
+    dh_prev = dh_prev + drh * r
+
+    du_pre = du_act * u * (1 - u)
+    dr_pre = dr_act * r * (1 - r)
+    dur = jnp.concatenate([du_pre, dr_pre], axis=1)
+    w_ur = w_ref[:, : 2 * H]
+    dh_prev = dh_prev + jnp.dot(
+        dur.astype(dt), w_ur.T, preferred_element_type=jnp.float32
+    )
+
+    dx_ref[0] = jnp.concatenate([du_pre, dr_pre, dc_pre], axis=1).astype(dt)
+    dh_s[:] = dh_prev.astype(dh_s.dtype)
+    if accumulate_dw:
+        rh = (r * h_prev32).astype(dt)
+        dw_s[:, : 2 * H] = dw_s[:, : 2 * H] + jnp.dot(
+            h_prev.T, dur.astype(dt), preferred_element_type=jnp.float32
+        )
+        dw_s[:, 2 * H:] = dw_s[:, 2 * H:] + jnp.dot(
+            rh.T, dc_pre.astype(dt), preferred_element_type=jnp.float32
+        )
+
+        @pl.when(s == pl.num_programs(0) - 1)
+        def _():
+            dw_ref[:] = dw_s[:].astype(dw_ref.dtype)
+
+
+def _gru_bwd_kernel_nodw(
+    ur_pre_ref, c_pre_ref, hprev_ref, dh_seq_ref, m_ref, w_ref, dhT_ref,
+    dx_ref, dh_s,
+):
+    _gru_bwd_kernel(
+        ur_pre_ref, c_pre_ref, hprev_ref, dh_seq_ref, m_ref, w_ref, dhT_ref,
+        dx_ref, None, dh_s, None, accumulate_dw=False,
+    )
+
+
+_GRU_FUSED_DW_MAX_H = 640  # 12H² f32 accumulator bytes vs ~16 MB VMEM
+
+
+def _gru_bwd_pallas(x_tbh, mask, w_rec, h_seq, dh_seq, dhT):
+    T, B, H3 = x_tbh.shape
+    H = H3 // 3
+    dt = x_tbh.dtype
+    zeros = jnp.zeros((1, B, H), dt)
+    h_prev_seq = jnp.concatenate([zeros, h_seq[:-1]], axis=0)
+    # batched pre-activation recompute (no recurrence): u/r first, then the
+    # candidate path through r·h_prev
+    ur_pre = x_tbh[:, :, : 2 * H] + jnp.einsum(
+        "tbh,hk->tbk", h_prev_seq, w_rec[:, : 2 * H],
+        preferred_element_type=jnp.float32,
+    ).astype(dt)
+    r_seq = jax.nn.sigmoid(ur_pre[:, :, H:].astype(jnp.float32))
+    rh_seq = (r_seq * h_prev_seq.astype(jnp.float32)).astype(dt)
+    c_pre = x_tbh[:, :, 2 * H:] + jnp.einsum(
+        "tbh,hk->tbk", rh_seq, w_rec[:, 2 * H:],
+        preferred_element_type=jnp.float32,
+    ).astype(dt)
+    fuse_dw = H <= _GRU_FUSED_DW_MAX_H
+    rev = lambda t: (T - 1 - t, 0, 0)  # noqa: E731 — reverse-time index map
+    out_specs = [pl.BlockSpec((1, B, H3), rev)]
+    out_shape = [jax.ShapeDtypeStruct((T, B, H3), dt)]
+    scratch = [pltpu.VMEM((B, H), dt)]
+    if fuse_dw:
+        out_specs.append(pl.BlockSpec((H, H3), lambda t: (0, 0)))
+        out_shape.append(jax.ShapeDtypeStruct((H, H3), dt))
+        scratch.append(pltpu.VMEM((H, H3), jnp.float32))
+    outs = pl.pallas_call(
+        _gru_bwd_kernel if fuse_dw else _gru_bwd_kernel_nodw,
+        grid=(T,),
+        in_specs=[
+            pl.BlockSpec((1, B, 2 * H), rev),
+            pl.BlockSpec((1, B, H), rev),
+            pl.BlockSpec((1, B, H), rev),
+            pl.BlockSpec((1, B, H), rev),
+            pl.BlockSpec((1, 1, B), rev),
+            pl.BlockSpec((H, H3), lambda t: (0, 0)),
+            pl.BlockSpec((B, H), lambda t: (0, 0)),
+        ],
+        out_specs=out_specs,
+        out_shape=out_shape,
+        scratch_shapes=scratch,
+        interpret=_interpret(),
+    )(
+        ur_pre,
+        c_pre,
+        h_prev_seq,
+        dh_seq,
+        mask.astype(jnp.float32).reshape(T, 1, B),
+        w_rec,
+        dhT,
+    )
+    if fuse_dw:
+        dx, dw = outs
+    else:
+        (dx,) = outs if isinstance(outs, (list, tuple)) else (outs,)
+        dw_ur = jnp.einsum(
+            "tbh,tbk->hk", h_prev_seq, dx[:, :, : 2 * H],
+            preferred_element_type=jnp.float32,
+        )
+        dw_c = jnp.einsum(
+            "tbh,tbk->hk", rh_seq, dx[:, :, 2 * H:],
+            preferred_element_type=jnp.float32,
+        )
+        dw = jnp.concatenate([dw_ur, dw_c], axis=1).astype(dt)
+    return dx, dw
+
+
 def gru_fused(x_tbh, mask, w_rec, bias=None, reverse=False):
     """Fused GRU over the whole sequence (zero-boot, sigmoid/tanh)."""
     if bias is not None:
@@ -405,20 +629,15 @@ def _gru_fused_core(x_tbh, mask, w_rec):
     return h_seq, h_T
 
 
-def _gru_scan_ref(x_tbh, mask, w_rec):
-    from .rnn_ops import gru_scan
-
-    return gru_scan(x_tbh, mask, w_rec, None)
-
-
 def _gru_fwd(x_tbh, mask, w_rec):
-    return _gru_fused_core(x_tbh, mask, w_rec), (x_tbh, mask, w_rec)
+    h_seq, h_T = _gru_pallas_raw(x_tbh, mask, w_rec)
+    return (h_seq, h_T), (x_tbh, mask, w_rec, h_seq)
 
 
 def _gru_bwd(res, ct):
-    x_tbh, mask, w_rec = res
-    _, vjp = jax.vjp(lambda x, w: _gru_scan_ref(x, mask, w), x_tbh, w_rec)
-    dx, dw = vjp(ct)
+    x_tbh, mask, w_rec, h_seq = res
+    dh_seq, dhT = ct
+    dx, dw = _gru_bwd_pallas(x_tbh, mask, w_rec, h_seq, dh_seq, dhT)
     return dx, None, dw
 
 

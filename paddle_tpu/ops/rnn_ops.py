@@ -180,7 +180,8 @@ def dynamic_lstm_kernel(ctx):
     reverse = ctx.attr("is_reverse", False)
     B, H = x_tb.shape[1], w.shape[0]
     if FLAGS.use_fused_rnn and pallas_kernels.lstm_supported(
-        B, H, gate_act, cell_act, cand_act, peep
+        B, H, gate_act, cell_act, cand_act, peep,
+        itemsize=x_tb.dtype.itemsize,
     ):
         h_seq, (h_T, c_T) = pallas_kernels.lstm_fused(
             x_tb, mask, w, bias=b, reverse=reverse
@@ -217,7 +218,7 @@ def dynamic_gru_kernel(ctx):
     reverse = ctx.attr("is_reverse", False)
     B, H = x_tb.shape[1], w.shape[0]
     if FLAGS.use_fused_rnn and pallas_kernels.gru_supported(
-        B, H, gate_act, cand_act
+        B, H, gate_act, cand_act, itemsize=x_tb.dtype.itemsize
     ):
         h_seq, h_T = pallas_kernels.gru_fused(
             x_tb, mask, w, bias=b, reverse=reverse
