@@ -346,6 +346,7 @@ class Executor:
             else program.random_seed,
             dtype=jnp.uint32,
         )
+        state, feed, seed = self._place_inputs(program, state, feed, seed)
         with self._device_context():
             fetches, new_state = fn(state, feed, seed)
         if FLAGS.check_nan_inf:
@@ -363,6 +364,15 @@ class Executor:
                 np.asarray(f) if not isinstance(f, LoDArray) else f for f in fetches
             ]
         return fetches
+
+    # ------------------------------------------------------------------
+    def _place_inputs(self, program, state, feed, seed):
+        """Hook: place host values onto devices before the jitted call.
+
+        The base executor lets jit commit single-device inputs; the
+        multi-process ParallelExecutor overrides this with explicit
+        device_puts (jit cannot reshard onto devices it cannot address)."""
+        return state, feed, seed
 
     # ------------------------------------------------------------------
     def _build(self, program: Program, feed_names, fetch_names, persist_names):

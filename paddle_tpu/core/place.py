@@ -24,7 +24,12 @@ class Place:
 
     @property
     def device(self) -> jax.Device:
-        devs = jax.devices(self.backend) if self.backend else jax.devices()
+        # a Place names a device THIS process can address: under
+        # multi-process jax.distributed, jax.devices() is the global list
+        # and its first entry belongs to process 0 — indexing it from
+        # another process would pin the executor to hardware it cannot
+        # touch (single-process: local == global, nothing changes)
+        devs = jax.local_devices(backend=self.backend or None)
         return devs[self.device_id % len(devs)]
 
     def __repr__(self) -> str:

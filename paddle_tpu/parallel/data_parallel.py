@@ -29,6 +29,7 @@ from __future__ import annotations
 from typing import Any, Optional
 
 import jax
+import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 from ..core.executor import Executor
@@ -105,6 +106,46 @@ class ParallelExecutor(Executor):
         return shard_leaf(value)
 
     # -- Executor hooks -----------------------------------------------------
+    @property
+    def _multiprocess(self) -> bool:
+        return len(self.mesh.devices.reshape(-1)) > jax.local_device_count()
+
+    def _place_inputs(self, program, state, feed, seed):
+        """Cross-process placement (DCN path, SURVEY §5.8): jit cannot
+        reshard an input onto devices this process cannot address, so host
+        values are device_put explicitly onto their global shardings.
+        Every process passes the same host value; device_put ships only
+        the local shards (the reference's trainer feeding its pserver
+        shard). Arrays already global (previous steps' outputs) pass
+        through untouched."""
+        if not self._multiprocess:
+            return state, feed, seed
+
+        def is_placed(v):
+            return isinstance(v, jax.Array) and not v.is_fully_addressable
+
+        def put(v, sharding):
+            return v if is_placed(v) else jax.device_put(np.asarray(v), sharding)
+
+        state = {
+            n: put(v, self._state_sharding(program, n))
+            for n, v in state.items()
+        }
+
+        def put_feed(v):
+            sh = self._feed_sharding(v)
+            if isinstance(v, LoDArray):
+                leaves, treedef = jax.tree.flatten(v)
+                shs = treedef.flatten_up_to(sh)
+                return treedef.unflatten(
+                    [put(leaf, s) for leaf, s in zip(leaves, shs)]
+                )
+            return put(v, sh)
+
+        feed = {k: put_feed(v) for k, v in feed.items()}
+        seed = put(seed, NamedSharding(self.mesh, PartitionSpec()))
+        return state, feed, seed
+
     def _cache_key_prefix(self) -> tuple:
         return ("par", id(self.mesh))
 
