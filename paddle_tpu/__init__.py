@@ -51,7 +51,7 @@ from .core import (  # noqa: F401
     reset_global_scope,
 )
 from .gradient_checker import check_gradient  # noqa: F401
-from .param_attr import ParamAttr  # noqa: F401
+from .param_attr import ParamAttr, StaticPruningHook  # noqa: F401
 from .trainer import (  # noqa: F401
     BeginIteration,
     BeginPass,

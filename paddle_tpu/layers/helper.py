@@ -71,6 +71,13 @@ class LayerHelper:
         param.grad_clip = attr.gradient_clip
         param.optimize_attr = {"learning_rate": attr.learning_rate}
         init(param, self.startup_program)
+        hooks = getattr(attr, "update_hooks", None)
+        if hooks:
+            # ParameterUpdaterHook seam (param_attr.StaticPruningHook):
+            # the hook's mask init must follow the param's init op
+            param.update_hooks = list(hooks)
+            for hook in param.update_hooks:
+                hook.append_startup(param, self.block, self.startup_program)
         return param
 
     def create_tmp_variable(self, dtype=np.float32, shape=(), lod_level=0) -> Variable:

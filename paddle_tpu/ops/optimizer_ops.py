@@ -262,3 +262,27 @@ def proximal_gd_kernel(ctx):
         / (1.0 + lr * l2)
     )
     _write(ctx, "Param", p_new)
+
+
+@register_op("prune_mask_init")
+def prune_mask_init_kernel(ctx):
+    """Reference: ParameterUpdaterHook.cpp:105 StaticPruningHook::
+    generateMask — sort |w|, zero the smallest sparsity_ratio fraction.
+    Runs once in the startup program, after the param's initializer."""
+    w = ctx.input("Param")
+    ratio = float(ctx.attr("sparsity_ratio", 0.8))
+    flat = jnp.abs(w).reshape(-1)
+    k = int(round(ratio * flat.size))
+    if k <= 0:
+        ctx.set_output("Out", jnp.ones_like(w))
+        return
+    thr = jnp.sort(flat)[k - 1]
+    ctx.set_output("Out", (jnp.abs(w) > thr).astype(w.dtype))
+
+
+@register_op("apply_mask")
+def apply_mask_kernel(ctx):
+    """Reference: ParameterUpdaterHook.cpp:86 StaticPruningHook::update —
+    re-apply the static mask after every optimizer step."""
+    p, m = ctx.input("Param"), ctx.input("Mask")
+    _write(ctx, "Param", p * m)

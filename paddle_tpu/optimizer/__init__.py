@@ -348,6 +348,11 @@ class Optimizer:
                     attrs={"scale": mult},
                 )
             self._append_update_op(helper, p, g, plr)
+            # ParameterUpdaterHook (Gen-1 update_hooks, e.g. static
+            # pruning): runs after the update so masked weights stay
+            # masked whatever the optimizer wrote
+            for hook in getattr(p, "update_hooks", None) or []:
+                hook.append_update(helper, p)
         # mark the backward+update slice so io._prune_for_inference and
         # Program test-clones can drop it wholesale (fluid marks these with
         # op_role=Optimize; same idea)

@@ -2,13 +2,61 @@
 
 Reference: python/paddle/v2/fluid/param_attr.py — name, initializer,
 learning_rate multiplier, regularizer, trainable, gradient clip; same fields
-here, consumed by LayerHelper.create_parameter (layers/helper.py).
+here, consumed by LayerHelper.create_parameter (layers/helper.py). The
+`update_hooks` field carries the Gen-1 ParameterAttribute(update_hooks=...)
+seam (trainer_config_helpers/attrs.py HookAttribute →
+paddle/parameter/ParameterUpdaterHook.cpp).
 """
 
 from __future__ import annotations
 
-from dataclasses import dataclass
-from typing import Any, Optional
+from dataclasses import dataclass, field
+from typing import Any, List, Optional
+
+
+@dataclass
+class StaticPruningHook:
+    """Mask-based static sparsity maintained across optimizer updates.
+
+    Reference: paddle/parameter/ParameterUpdaterHook.cpp:39
+    (StaticPruningHook: `generateMask` sorts |w| at init time and zeroes
+    the smallest `sparsity_ratio` fraction; `update()` re-applies the mask
+    after every optimizer step so pruned weights stay zero). TPU design:
+    the mask is a persistable `<param>@PRUNE_MASK` variable computed by a
+    startup-program op from the freshly initialized weights, and an
+    `apply_mask` op appended to the optimizer slice multiplies it back in
+    each step — everything stays inside the jitted train step.
+    """
+
+    sparsity_ratio: float = 0.8
+
+    def mask_name(self, param) -> str:
+        return f"{param.name}@PRUNE_MASK"
+
+    def append_startup(self, param, main_block, startup_program) -> None:
+        """Create the mask variable and its init op (runs after the
+        param's initializer op in the startup program)."""
+        mask = main_block.create_var(
+            self.mask_name(param), tuple(param.shape), param.dtype,
+            persistable=True,
+        )
+        sb = startup_program.global_block()
+        sb.create_var(mask.name, tuple(param.shape), param.dtype,
+                      persistable=True)
+        sb.append_op(
+            "prune_mask_init",
+            inputs={"Param": [param.name]},
+            outputs={"Out": [mask.name]},
+            attrs={"sparsity_ratio": float(self.sparsity_ratio)},
+        )
+
+    def append_update(self, helper, param) -> None:
+        mask = helper.main_program.global_block().var(self.mask_name(param))
+        helper.append_op(
+            type="apply_mask",
+            inputs={"Param": [param], "Mask": [mask]},
+            outputs={"ParamOut": [param]},
+        )
 
 
 @dataclass
@@ -19,6 +67,7 @@ class ParamAttr:
     regularizer: Any = None
     trainable: bool = True
     gradient_clip: Any = None
+    update_hooks: Optional[List[Any]] = None
 
     @staticmethod
     def to_attr(arg) -> "ParamAttr":
