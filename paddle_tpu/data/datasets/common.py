@@ -1,0 +1,62 @@
+"""Dataset download machinery.
+
+Reference: python/paddle/v2/dataset/common.py:55-100 (`md5file`,
+`download(url, module_name, md5sum)` — cache under DATA_HOME/module_name,
+verify checksum, re-download up to 3 times). Same contract here, built on
+urllib (no requests dependency) and network-off safe: with no egress a
+cached-and-verified file is returned without touching the network, and a
+failed fetch raises a RuntimeError naming the cache path to pre-seed.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import shutil
+from typing import Optional
+
+from . import data_home
+
+__all__ = ["md5file", "download"]
+
+
+def md5file(fname: str) -> str:
+    """Reference: common.py:55 — streaming md5 of a file."""
+    h = hashlib.md5()
+    with open(fname, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 16), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def download(url: str, module_name: str, md5sum: str,
+             save_name: Optional[str] = None) -> str:
+    """Return the path of the cached, checksum-verified file; fetch it if
+    missing. Reference: common.py:65."""
+    dirname = os.path.join(data_home(), module_name)
+    os.makedirs(dirname, exist_ok=True)
+    filename = os.path.join(
+        dirname, save_name if save_name else url.split("/")[-1]
+    )
+
+    retry, retry_limit = 0, 3
+    while not (os.path.exists(filename) and md5file(filename) == md5sum):
+        if retry == retry_limit:
+            raise RuntimeError(
+                f"cannot download {url} within {retry_limit} retries; "
+                f"if this host has no egress, pre-seed the cache file at "
+                f"{filename} (md5 {md5sum})"
+            )
+        retry += 1
+        tmp = filename + ".part"
+        try:
+            import urllib.request
+
+            with urllib.request.urlopen(url, timeout=30) as r, \
+                    open(tmp, "wb") as f:
+                shutil.copyfileobj(r, f)
+            os.replace(tmp, filename)
+        except Exception:  # noqa: BLE001 — retry loop decides fatality
+            if os.path.exists(tmp):
+                os.remove(tmp)
+    return filename

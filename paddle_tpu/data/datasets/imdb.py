@@ -33,28 +33,32 @@ def _tokenize(text):
     return re.sub(r"[^a-z0-9 ]", " ", text.lower()).split()
 
 
-def _build_real_dict(root):
+def _build_real_dict(root, min_freq=30):
     from collections import Counter
 
     cnt = Counter()
     for path in glob.glob(os.path.join(root, "train", "*", "*.txt")):
         with open(path, errors="ignore") as f:
             cnt.update(_tokenize(f.read()))
-    words = [w for w, c in cnt.most_common() if c > 30]
+    # strictly > like the reference's build_dict cutoff (imdb.py:66)
+    words = [w for w, c in cnt.most_common() if c > min_freq]
     return {w: i for i, w in enumerate(words)}
 
 
-def word_dict():
-    """Reference: imdb.word_dict() — token → id. Uses real aclImdb data
-
-    under data_home()/imdb/aclImdb when present, else a synthetic vocab."""
+def word_dict(min_freq=30):
+    """Reference: imdb.word_dict() — token → id (strict frequency cutoff
+    like the reference's build_dict(re, 150)). Uses real aclImdb data under
+    data_home()/imdb/aclImdb when present, else a synthetic vocab."""
     global _word_dict_cache
     if _word_dict_cache is None:
+        _word_dict_cache = {}
+    if min_freq not in _word_dict_cache:
         root = _real_dir()
-        _word_dict_cache = (
-            _build_real_dict(root) if root else {f"w{i}": i for i in range(_VOCAB)}
+        _word_dict_cache[min_freq] = (
+            _build_real_dict(root, min_freq) if root
+            else {f"w{i}": i for i in range(_VOCAB)}
         )
-    return _word_dict_cache
+    return _word_dict_cache[min_freq]
 
 
 def _real_reader(split):

@@ -6,16 +6,99 @@ Reference sample schema (train()/test()):
 Helper API: max_user_id/max_movie_id/max_job_id, age_table,
 movie_categories(), user_info(), movie_info().
 
-With no egress, users/movies get latent factors and ratings follow
+The real ml-1m.zip ('::'-separated users.dat/movies.dat/ratings.dat,
+reference movielens.py:102-163, split by random.Random(0) per rating at
+test_ratio=0.1) is parsed when present under data_home()/movielens.
+Otherwise users/movies get latent factors and ratings follow
 score = clip(round(u·v + biases), 1..5), so the dual-tower regression model
 has real signal to learn.
 """
 
 from __future__ import annotations
 
+import os
+import random
+import re
+import zipfile
+
 import numpy as np
 
+from . import data_home
+
 age_table = [1, 18, 25, 35, 45, 50, 56]
+
+URL = "http://files.grouplens.org/datasets/movielens/ml-1m.zip"
+MD5 = "c4d9eecfca2ab87c1945afe126590906"
+
+
+def fetch():
+    from .common import download
+
+    return download(URL, "movielens", MD5)
+
+
+def _real_zip():
+    p = os.path.join(data_home(), "movielens", "ml-1m.zip")
+    return p if os.path.exists(p) else None
+
+
+_REAL_META = None
+
+
+def _real_meta(zip_path):
+    """Parse movies.dat/users.dat into this module's id-based schema
+    (reference movielens.py:102-143 __initialize_meta_info__)."""
+    global _REAL_META
+    if _REAL_META is not None:
+        return _REAL_META
+    pattern = re.compile(r"^(.*)\((\d+)\)$")
+    movies, users = {}, {}
+    title_words, categories = set(), set()
+    with zipfile.ZipFile(zip_path) as z:
+        with z.open("ml-1m/movies.dat") as f:
+            for line in f:
+                mid, title, cats = line.decode("latin-1").strip().split("::")
+                cats = cats.split("|")
+                categories.update(cats)
+                m = pattern.match(title)
+                title = (m.group(1) if m else title).strip()
+                movies[int(mid)] = (title, cats)
+                title_words.update(w.lower() for w in title.split())
+        with z.open("ml-1m/users.dat") as f:
+            for line in f:
+                uid, gender, age, job, _ = \
+                    line.decode("latin-1").strip().split("::")
+                users[int(uid)] = (0 if gender == "M" else 1,
+                                   age_table.index(int(age)), int(job))
+    cat_dict = {c: i for i, c in enumerate(sorted(categories))}
+    title_dict = {w: i for i, w in enumerate(sorted(title_words))}
+    _REAL_META = (movies, users, cat_dict, title_dict)
+    return _REAL_META
+
+
+def _real_reader(zip_path, is_test, rand_seed=0, test_ratio=0.1):
+    """Reference movielens.py:145 __reader__ — per-rating random split."""
+    def reader():
+        movies, users, cat_dict, title_dict = _real_meta(zip_path)
+        rand = random.Random(x=rand_seed)
+        with zipfile.ZipFile(zip_path) as z, \
+                z.open("ml-1m/ratings.dat") as f:
+            for line in f:
+                if (rand.random() < test_ratio) != is_test:
+                    continue
+                uid, mid, score, _ = \
+                    line.decode("latin-1").strip().split("::")
+                uid, mid = int(uid), int(mid)
+                gender, age_id, job = users[uid]
+                title, cats = movies[mid]
+                yield (
+                    uid, gender, age_id, job, mid,
+                    [cat_dict[c] for c in cats],
+                    [title_dict[w.lower()] for w in title.split()],
+                    float(score),
+                )
+
+    return reader
 
 _N_USERS = 400
 _N_MOVIES = 300
@@ -27,22 +110,42 @@ _DIM = 6
 
 
 def max_user_id() -> int:
+    z = _real_zip()
+    if z:
+        _, users, _, _ = _real_meta(z)
+        return max(users)
     return _N_USERS
 
 
 def max_movie_id() -> int:
+    z = _real_zip()
+    if z:
+        movies, _, _, _ = _real_meta(z)
+        return max(movies)
     return _N_MOVIES
 
 
 def max_job_id() -> int:
+    z = _real_zip()
+    if z:
+        _, users, _, _ = _real_meta(z)
+        return max(job for _, _, job in users.values())
     return _N_JOBS - 1
 
 
 def movie_categories():
+    z = _real_zip()
+    if z:
+        _, _, cat_dict, _ = _real_meta(z)
+        return dict(cat_dict)
     return {f"genre{i}": i for i in range(_N_CATEGORIES)}
 
 
 def get_movie_title_dict():
+    z = _real_zip()
+    if z:
+        _, _, _, title_dict = _real_meta(z)
+        return dict(title_dict)
     return {f"t{i}": i for i in range(_TITLE_VOCAB)}
 
 
@@ -77,6 +180,11 @@ def _get_factors():
 
 
 def user_info():
+    z = _real_zip()
+    if z:
+        _, users, _, _ = _real_meta(z)
+        return {uid: {"gender": g, "age": a, "job": j}
+                for uid, (g, a, j) in users.items()}
     _, _, _, _, genders, ages, jobs, _, _ = _get_factors()
     return {
         i: {"gender": int(genders[i]), "age": int(ages[i]), "job": int(jobs[i])}
@@ -85,6 +193,12 @@ def user_info():
 
 
 def movie_info():
+    z = _real_zip()
+    if z:
+        movies, _, cat_dict, title_dict = _real_meta(z)
+        return {mid: {"categories": [cat_dict[c] for c in cats],
+                      "title": [title_dict[w.lower()] for w in t.split()]}
+                for mid, (t, cats) in movies.items()}
     *_, cats, titles = _get_factors()
     return {
         i: {"categories": [int(c) for c in cats[i]], "title": [int(t) for t in titles[i]]}
@@ -117,8 +231,14 @@ def _reader(n, seed):
 
 
 def train():
+    z = _real_zip()
+    if z:
+        return _real_reader(z, is_test=False)
     return _reader(_N_TRAIN, 11)
 
 
 def test():
+    z = _real_zip()
+    if z:
+        return _real_reader(z, is_test=True)
     return _reader(_N_TEST, 12)

@@ -1,14 +1,21 @@
 """UCI housing dataset (reference: python/paddle/v2/dataset/uci_housing.py).
 
 Sample schema: (features[13] float32, price[1] float32), features
-standardized. With no egress the data is synthesized from a fixed linear
-model + noise — statistically equivalent for the fit_a_line acceptance test
-(book/01), which only asserts loss convergence.
+standardized. The real housing.data (whitespace floats, 14 columns,
+(x-avg)/(max-min) normalization, 80/20 split — reference
+uci_housing.py:60-75) is parsed when present under
+data_home()/uci_housing; otherwise the data is synthesized from a fixed
+linear model + noise — statistically equivalent for the fit_a_line
+acceptance test (book/01), which only asserts loss convergence.
 """
 
 from __future__ import annotations
 
+import os
+
 import numpy as np
+
+from . import data_home
 
 feature_names = [
     "CRIM", "ZN", "INDUS", "CHAS", "NOX", "RM", "AGE",
@@ -16,6 +23,33 @@ feature_names = [
 ]
 
 _N_TRAIN, _N_TEST = 404, 102
+
+URL = "https://archive.ics.uci.edu/ml/machine-learning-databases/housing/housing.data"
+MD5 = "d4accdce7a25600298819f8e28e8d593"
+
+
+def fetch():
+    from .common import download
+
+    return download(URL, "uci_housing", MD5)
+
+
+def _real_file():
+    p = os.path.join(data_home(), "uci_housing", "housing.data")
+    return p if os.path.exists(p) else None
+
+
+def _load_real(filename, feature_num=14, ratio=0.8):
+    """Reference: uci_housing.py:60 load_data — (x-avg)/(max-min) per
+    feature, first 80% train / rest test."""
+    data = np.fromfile(filename, sep=" ")
+    data = data.reshape(data.shape[0] // feature_num, feature_num)
+    maxs, mins = data.max(axis=0), data.min(axis=0)
+    avgs = data.mean(axis=0)
+    for i in range(feature_num - 1):
+        data[:, i] = (data[:, i] - avgs[i]) / (maxs[i] - mins[i])
+    offset = int(data.shape[0] * ratio)
+    return data[:offset].astype(np.float32), data[offset:].astype(np.float32)
 
 
 def _make(n, seed):
@@ -26,19 +60,27 @@ def _make(n, seed):
     return x, y.astype(np.float32)
 
 
-def train():
+def _reader(is_train):
     def reader():
-        x, y = _make(_N_TRAIN, seed=0)
+        f = _real_file()
+        if f:
+            tr, te = _load_real(f)
+            rows = tr if is_train else te
+            for row in rows:
+                yield row[:-1], row[-1:]
+            return
+        x, y = _make(
+            _N_TRAIN if is_train else _N_TEST, seed=0 if is_train else 1
+        )
         for i in range(x.shape[0]):
             yield x[i], y[i]
 
     return reader
+
+
+def train():
+    return _reader(True)
 
 
 def test():
-    def reader():
-        x, y = _make(_N_TEST, seed=1)
-        for i in range(x.shape[0]):
-            yield x[i], y[i]
-
-    return reader
+    return _reader(False)

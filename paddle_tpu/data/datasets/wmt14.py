@@ -5,15 +5,22 @@ where trg_ids is <s>-prefixed and trg_ids_next is the shifted target ending
 in <e> — the three feeds of the machine_translation book model (book/08).
 Special ids follow the reference: <s>=0, <e>=1, <unk>=2.
 
-Synthetic generation: the "translation" of a source sentence is its reversal
-with a fixed vocabulary permutation — a deterministic mapping that a
-seq2seq-with-attention model can actually learn, giving the acceptance test
-a convergence signal.
+The real wmt14.tgz (src.dict / trg.dict / train/train / test/test members,
+tab-separated parallel lines — reference wmt14.py:53-110) is parsed when
+present under data_home()/wmt14; otherwise synthetic generation: the
+"translation" of a source sentence is its reversal with a fixed vocabulary
+permutation — a deterministic mapping that a seq2seq-with-attention model
+can actually learn, giving the acceptance test a convergence signal.
 """
 
 from __future__ import annotations
 
+import os
+import tarfile
+
 import numpy as np
+
+from . import data_home
 
 START = "<s>"
 END = "<e>"
@@ -22,6 +29,61 @@ START_ID, END_ID, UNK_ID = 0, 1, 2
 _RESERVED = 3
 
 _N_TRAIN, _N_TEST = 3000, 300
+
+URL_TRAIN = ("http://paddlepaddle.cdn.bcebos.com/demo/"
+             "wmt_shrinked_data/wmt14.tgz")
+MD5_TRAIN = "0791583d57d5beb693b9414c5b36798c"
+
+
+def _real_tar():
+    p = os.path.join(data_home(), "wmt14", "wmt14.tgz")
+    return p if os.path.exists(p) else None
+
+
+def fetch():
+    """Reference: common.download(URL_TRAIN, 'wmt14', MD5_TRAIN)."""
+    from .common import download
+
+    return download(URL_TRAIN, "wmt14", MD5_TRAIN)
+
+
+def _read_real_dict(tar_path, suffix, dict_size):
+    with tarfile.open(tar_path) as f:
+        names = [m.name for m in f if m.name.endswith(suffix)]
+        assert len(names) == 1, (suffix, names)
+        out = {}
+        for i, line in enumerate(f.extractfile(names[0])):
+            if i >= dict_size:
+                break
+            out[line.strip().decode("utf-8")] = i
+        return out
+
+
+def _real_reader(tar_path, member_suffix, dict_size):
+    """Reference: wmt14.py reader_creator — <s>/<e>-wrapped source ids,
+    <s>-prefixed target, next-target ending in <e>; drop length>80."""
+    # parsed once per reader creator, not once per epoch
+    src_dict = _read_real_dict(tar_path, "src.dict", dict_size)
+    trg_dict = _read_real_dict(tar_path, "trg.dict", dict_size)
+
+    def reader():
+        with tarfile.open(tar_path) as f:
+            names = [m.name for m in f if m.name.endswith(member_suffix)]
+            for name in names:
+                for line in f.extractfile(name):
+                    parts = line.decode("utf-8").strip().split("\t")
+                    if len(parts) != 2:
+                        continue
+                    src_ids = [src_dict.get(w, UNK_ID)
+                               for w in [START] + parts[0].split() + [END]]
+                    trg_words = [trg_dict.get(w, UNK_ID)
+                                 for w in parts[1].split()]
+                    if len(src_ids) > 80 or len(trg_words) > 80:
+                        continue
+                    yield (src_ids, [trg_dict[START]] + trg_words,
+                           trg_words + [trg_dict[END]])
+
+    return reader
 
 
 def _perm(dict_size, seed=17):
@@ -49,15 +111,30 @@ def _reader(dict_size, n, seed):
 
 
 def train(dict_size: int):
+    tar = _real_tar()
+    if tar:
+        return _real_reader(tar, "train/train", dict_size)
     return _reader(dict_size, _N_TRAIN, 31)
 
 
 def test(dict_size: int):
+    tar = _real_tar()
+    if tar:
+        return _real_reader(tar, "test/test", dict_size)
     return _reader(dict_size, _N_TEST, 32)
 
 
 def get_dict(dict_size: int, reverse: bool = False):
-    """Reference API: (src_dict, trg_dict); synthetic vocab tokens."""
+    """Reference API: (src_dict, trg_dict)."""
+    tar = _real_tar()
+    if tar:
+        src = _read_real_dict(tar, "src.dict", dict_size)
+        trg = _read_real_dict(tar, "trg.dict", dict_size)
+        if reverse:
+            src = {v: k for k, v in src.items()}
+            trg = {v: k for k, v in trg.items()}
+        return src, trg
+
     def mk():
         d = {START: START_ID, END: END_ID, UNK: UNK_ID}
         for i in range(dict_size - _RESERVED):
