@@ -1,0 +1,16 @@
+#!/bin/bash
+# Transformer MFU sweep round 2: configs sized to fit 15.75G HBM.
+# f32 Adam state on params is the floor: 16 B/param + bf16 copy 2 B/param.
+cd /root/repo
+OUT=experiments/tfm_sweep2.log
+: > $OUT
+run() {
+  echo "=== $* ===" >> $OUT
+  timeout 900 env "$@" BENCH_MODEL=transformer python bench.py 2>>$OUT | tail -1 >> $OUT
+  echo >> $OUT
+}
+run BENCH_HIDDEN=2048 BENCH_DEPTH=8 BENCH_BATCH=8 BENCH_REMAT=dots
+run BENCH_HIDDEN=2048 BENCH_DEPTH=8 BENCH_BATCH=8 BENCH_REMAT=full
+run BENCH_HIDDEN=2048 BENCH_DEPTH=6 BENCH_BATCH=12 BENCH_REMAT=full
+run BENCH_HIDDEN=2048 BENCH_DEPTH=12 BENCH_BATCH=6 BENCH_REMAT=full
+echo DONE >> $OUT

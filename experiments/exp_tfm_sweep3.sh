@@ -1,0 +1,15 @@
+#!/bin/bash
+# Transformer MFU sweep 3: full remat won (36.7% at L8 bs8); push batch.
+cd /root/repo
+OUT=experiments/tfm_sweep3.log
+: > $OUT
+run() {
+  echo "=== $* ===" >> $OUT
+  timeout 900 env "$@" BENCH_MODEL=transformer python bench.py 2>>$OUT | tail -1 >> $OUT
+  echo >> $OUT
+}
+run BENCH_HIDDEN=2048 BENCH_DEPTH=8 BENCH_BATCH=12 BENCH_REMAT=full
+run BENCH_HIDDEN=2048 BENCH_DEPTH=8 BENCH_BATCH=14 BENCH_REMAT=full
+run BENCH_HIDDEN=2048 BENCH_DEPTH=10 BENCH_BATCH=8 BENCH_REMAT=full
+run BENCH_HIDDEN=2048 BENCH_DEPTH=12 BENCH_BATCH=5 BENCH_REMAT=full
+echo DONE >> $OUT

@@ -1,0 +1,16 @@
+#!/bin/bash
+# Transformer MFU sweep 4: no-remat variants at L8/L6 (remat recompute cost
+# visible: L12 bs4 none 35.5% > L12 bs5 full 33.1%).
+cd /root/repo
+OUT=experiments/tfm_sweep4.log
+: > $OUT
+run() {
+  echo "=== $* ===" >> $OUT
+  timeout 900 env "$@" BENCH_MODEL=transformer python bench.py 2>>$OUT | tail -1 >> $OUT
+  echo >> $OUT
+}
+run BENCH_HIDDEN=2048 BENCH_DEPTH=8 BENCH_BATCH=8
+run BENCH_HIDDEN=2048 BENCH_DEPTH=8 BENCH_BATCH=10
+run BENCH_HIDDEN=2048 BENCH_DEPTH=6 BENCH_BATCH=14
+run BENCH_HIDDEN=2048 BENCH_DEPTH=6 BENCH_BATCH=16
+echo DONE >> $OUT
