@@ -1,0 +1,112 @@
+"""Flash-attention block-size tuning for v5e (VERDICT r2 weak #3).
+
+The library kernel's get_default() is all-128 blocks (its own TODO admits
+no heuristic); v5e's MXU wants bigger tiles. Sweep block configurations
+at the T=1k-16k training shapes where round-2 measured flash/XLA
+0.59-0.71x, same DCE-proof chained fwd+bwd harness as exp_flash.py.
+
+Writes benchmarks/flash_block_tuning.json.
+"""
+import itertools
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental.pallas.ops.tpu.flash_attention import (
+    BlockSizes,
+    flash_attention as tpu_flash,
+)
+
+from paddle_tpu.ops.flash_ops import _reference
+
+
+def timeit(f, *args):
+    r = f(*args)
+    np.asarray(jax.tree.leaves(r)[0].ravel()[0])
+    t0 = time.perf_counter()
+    r = f(*args)
+    np.asarray(jax.tree.leaves(r)[0].ravel()[0])
+    return time.perf_counter() - t0
+
+
+def make_blocks(q_blk, k_blk, T):
+    q_blk, k_blk = min(q_blk, T), min(k_blk, T)
+    return BlockSizes(
+        block_q=q_blk, block_k_major=k_blk, block_k=k_blk, block_b=1,
+        block_q_major_dkv=q_blk, block_k_major_dkv=k_blk,
+        block_k_dkv=k_blk, block_q_dkv=q_blk,
+        block_k_major_dq=k_blk, block_k_dq=k_blk, block_q_dq=q_blk,
+    )
+
+
+def bench_point(B, T, H, D, reps=40):
+    rng = np.random.RandomState(0)
+    mk = lambda: jnp.asarray(rng.randn(B, T, H, D) * 0.3, jnp.bfloat16)  # noqa: E731
+    q, k, v = mk(), mk(), mk()
+    bhtd = lambda x: jnp.transpose(x, (0, 2, 1, 3))  # noqa: E731
+    qh, kh, vh = bhtd(q), bhtd(k), bhtd(v)
+    scale = float(1.0 / np.sqrt(D))
+
+    def many(fn, *xs):
+        @jax.jit
+        def run(qc, *rest):
+            def body(qc, _):
+                l, g = jax.value_and_grad(lambda q: jnp.sum(
+                    fn(q, *rest).astype(jnp.float32)))(qc)
+                return qc + jnp.asarray(1e-12, qc.dtype) * g, l
+            qc, ls = jax.lax.scan(body, qc, None, length=reps)
+            return ls[-1]
+        return timeit(run, *xs) / reps
+
+    t_xla = many(lambda q, k, v: _reference(q, k, v, True), q, k, v)
+    results = {"xla_ms": round(t_xla * 1e3, 3)}
+    best = None
+    for q_blk, k_blk in itertools.product((128, 256, 512, 1024),
+                                          (128, 256, 512, 1024)):
+        if q_blk > T or k_blk > T:
+            continue
+        try:
+            bs = make_blocks(q_blk, k_blk, T)
+            t = many(lambda qq, kk, vv: tpu_flash(
+                qq, kk, vv, causal=True, sm_scale=scale, block_sizes=bs),
+                qh, kh, vh)
+            results[f"flash_q{q_blk}_k{k_blk}_ms"] = round(t * 1e3, 3)
+            if best is None or t < best[1]:
+                best = ((q_blk, k_blk), t)
+        except Exception as e:  # noqa: BLE001 — config may not compile
+            results[f"flash_q{q_blk}_k{k_blk}_ms"] = \
+                "err:" + str(e).split("\n")[0][:80]
+        print({"B": B, "T": T, "last": list(results.items())[-1]},
+              flush=True)
+    results.update(
+        B=B, T=T, H=H, D=D,
+        best_blocks=None if best is None else list(best[0]),
+        best_ms=None if best is None else round(best[1] * 1e3, 3),
+        best_speedup_vs_xla=(None if best is None
+                             else round(t_xla / best[1], 3)),
+    )
+    return results
+
+
+if __name__ == "__main__":
+    rows = [
+        bench_point(2, 1024, 8, 128),
+        bench_point(2, 2048, 8, 128),
+        bench_point(2, 4096, 8, 64),
+        bench_point(1, 8192, 8, 128),
+        bench_point(1, 16384, 8, 128, reps=20),
+    ]
+    out = {"bench": "flash block-size sweep vs XLA, fwd+bwd causal, one chip",
+           "device": str(jax.devices()[0].device_kind), "rows": rows}
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "benchmarks",
+        "flash_block_tuning.json")
+    with open(path, "w") as f:
+        json.dump(out, f, indent=1)
+    print("wrote", path)

@@ -58,11 +58,15 @@ def _shapes_flash_ok(q, k) -> bool:
     return Tq % 128 == 0 and Tk % 128 == 0 and Dq in (64, 128, 256)
 
 
-# route to the kernel once the XLA formulation's [B, H, Tq, Tk] score
-# buffer would be painful: measured on v5e (PERF.md) XLA's fused unflashed
-# attention is FASTER fwd+bwd while its scores fit comfortably (0.64-0.86x
-# flash/xla at <=1 GB), and stops compiling outright around several GB —
-# the kernel's O(T) memory is a capability, not a shortcut
+# Dispatch policy (round 3, benchmarks/flash_block_tuning.json): with
+# v5e-tuned block sizes the kernel BEATS XLA's fused attention fwd+bwd
+# from T=1024 up — 1.4-1.5x at T=1-2k, 2.0x at 4k, 2.6x at 8k, 3.5x at
+# 16k (the library's all-128 default blocks were why round 2 measured
+# 0.59-0.71x). Below the measured window, or when the shape rules fail,
+# XLA keeps the job; the score-bytes rule stays as the memory-capability
+# route for shapes outside the measured-win window (XLA stops compiling
+# outright around several GB of scores).
+_FLASH_MIN_T = 1024
 _SCORE_BYTES_THRESHOLD = 1.5e9
 
 
@@ -71,6 +75,8 @@ def _prefers_flash(q, k) -> bool:
 
     B, Tq, H, _ = q.shape
     Tk = k.shape[1]
+    if Tq >= _FLASH_MIN_T and Tk >= _FLASH_MIN_T:
+        return True  # measured-win regime with tuned blocks
     # scores inherit the input dtype in the reference formulation: f32
     # inputs double the buffer vs bf16
     itemsize = np.dtype(q.dtype).itemsize
@@ -86,6 +92,30 @@ def flash_eligible(q, k=None) -> bool:
     )
 
 
+def _v5e_block_sizes(Tq: int, Tk: int):
+    """v5e-tuned blocking (benchmarks/flash_block_tuning.json): 512-wide
+    q/k blocks win up to T=4096, 1024 from 8192; repeated-trial medians
+    confirm 512/512 at T=1024/2048 (1.4-1.5x over XLA). The kernel
+    requires blocks to DIVIDE the sequence length, so the target rounds
+    down to the largest 128-multiple divisor (e.g. T=1280 → 256; T is
+    always 128-aligned here per _shapes_flash_ok)."""
+    from jax.experimental.pallas.ops.tpu.flash_attention import BlockSizes
+
+    def blk(T):
+        b = min(T, 512 if T < 8192 else 1024)
+        while T % b:
+            b -= 128
+        return b
+
+    qb, kb = blk(Tq), blk(Tk)
+    return BlockSizes(
+        block_q=qb, block_k_major=kb, block_k=kb, block_b=1,
+        block_q_major_dkv=qb, block_k_major_dkv=kb,
+        block_k_dkv=kb, block_q_dkv=qb,
+        block_k_major_dq=kb, block_k_dq=kb, block_q_dq=qb,
+    )
+
+
 def _flash_kernel(q, k, v, causal: bool):
     """Direct fused-kernel call, no dispatch gate (benchmarks and the
     eligible path both come through here)."""
@@ -97,16 +127,19 @@ def _flash_kernel(q, k, v, causal: bool):
     o = _tpu_flash(
         bhtd(q), bhtd(k), bhtd(v), causal=causal,
         sm_scale=float(1.0 / math.sqrt(q.shape[-1])),
+        block_sizes=_v5e_block_sizes(q.shape[1], k.shape[1]),
     )
     return jnp.transpose(o, (0, 2, 1, 3))
 
 
 def flash_attention(q, k, v, causal: bool = False):
-    """[B, T, H, D] attention; fused O(T)-memory TPU kernel for long
-    sequences, jnp reference otherwise (XLA's attention is faster while
-    its score matrix fits — the kernel takes over where XLA cannot go).
-    Numerics: bf16 io with f32 online-softmax accumulation inside the
-    kernel (matches the reference formulation to bf16 eps)."""
+    """[B, T, H, D] attention. From T=1024 the v5e-block-tuned fused
+    kernel is the fast path (1.4-3.5x over XLA's fused attention fwd+bwd,
+    benchmarks/flash_block_tuning.json) as well as the O(T)-memory path;
+    below that window XLA keeps the job unless the score buffer would
+    exceed the memory threshold. Numerics: bf16 io with f32
+    online-softmax accumulation inside the kernel (matches the reference
+    formulation to bf16 eps)."""
     if q.ndim != 4:
         raise ValueError(f"expected [B, T, H, D], got {q.shape}")
     if not flash_eligible(q, k):

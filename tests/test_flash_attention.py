@@ -69,13 +69,17 @@ def test_eligibility_rules():
     assert not _shapes_flash_ok(jnp.zeros((1, 256, 2, 48)), ok)   # head dim
     assert not flash_eligible(ok)  # CPU backend gate
 
-    # memory-threshold routing (PERF.md: XLA attention is FASTER while
-    # its score buffer fits; the kernel takes over past ~1.5 GB)
+    # routing (round 3, benchmarks/flash_block_tuning.json): the tuned
+    # kernel WINS from T=1024 up, so that whole regime routes to it;
+    # below the measured window only the memory-capability rule (score
+    # bytes past ~1.5 GB) pulls the kernel in
     from paddle_tpu.ops.flash_ops import _prefers_flash
 
-    small = jnp.zeros((2, 2048, 8, 128))   # scores ~134 MB → XLA
+    tiny = jnp.zeros((2, 512, 8, 128))     # below win window, 64 MB → XLA
+    medium = jnp.zeros((2, 2048, 8, 128))  # measured 1.5x win → kernel
     big = jnp.zeros((1, 32768, 4, 128))    # scores ~8.6 GB → kernel
-    assert not _prefers_flash(small, small)
+    assert not _prefers_flash(tiny, tiny)
+    assert _prefers_flash(medium, medium)
     assert _prefers_flash(big, big)
 
 
@@ -90,3 +94,24 @@ def test_ulysses_uses_flash_dispatch_path():
     ref = pp.scaled_dot_product_attention(q, q, q, causal=True)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=1e-4, atol=1e-5)
+
+
+def test_v5e_blocks_divide_any_eligible_length():
+    """The kernel hard-crashes if a block doesn't divide T; every
+    128-aligned T the shape rules admit must get divisor blocks."""
+    from paddle_tpu.ops.flash_ops import _v5e_block_sizes
+
+    for T in (1024, 1152, 1280, 2048, 4096, 8192, 8320, 16384, 33280):
+        bs = _v5e_block_sizes(T, T)
+        assert T % bs.block_q == 0 and T % bs.block_k == 0, (T, bs)
+        assert bs.block_q % 128 == 0 and bs.block_k % 128 == 0
+    # the tuned targets are hit where they divide
+    assert _v5e_blocks_q(2048) == 512
+    assert _v5e_blocks_q(16384) == 1024
+    assert _v5e_blocks_q(1280) == 256
+
+
+def _v5e_blocks_q(T):
+    from paddle_tpu.ops.flash_ops import _v5e_block_sizes
+
+    return _v5e_block_sizes(T, T).block_q
