@@ -271,6 +271,11 @@ def run_all():
     results = {}
     for model, extra_env in _ALL_MODELS:
         env = dict(os.environ)
+        # mode flags would otherwise leak into every child and replace
+        # the headline metrics with e.g. overlap ratios
+        for flag in ("BENCH_OVERLAP", "BENCH_PIPELINE", "BENCH_HIDDEN",
+                     "BENCH_DEPTH", "BENCH_REMAT", "BENCH_BATCH"):
+            env.pop(flag, None)
         env["BENCH_MODEL"] = model
         env.update(extra_env)
         try:
@@ -309,6 +314,64 @@ def main():
     prog, loss = cfg["prog"], cfg["loss"]
     exe = pt.Executor(donate_state=True)
     exe.run(cfg["startup"])
+
+    if os.environ.get("BENCH_OVERLAP") == "1":
+        # input-overlap efficiency WITHOUT the tunnel confound (PERF.md,
+        # VERDICT r2 weak #7): the axon link caps h2d at single-digit
+        # MB/s, three decades below a real TPU host's DMA path, so the
+        # 77 MB/batch ResNet feed cannot be driven through it. Instead:
+        # real device compute (the same chained step), real
+        # DevicePrefetcher thread+queue machinery, and a producer
+        # throttled to BENCH_OVERLAP_RATE x the measured step time that
+        # hands out pre-staged device buffers — measuring whether the
+        # overlap hides a producer that is faster than the step.
+        import itertools
+        import time as _time
+
+        from paddle_tpu.data.feeder import DevicePrefetcher
+
+        feed0 = {k: jax.device_put(v) for k, v in cfg["feed"].items()}
+        for _ in range(3):
+            (l,) = exe.run(prog, feed=feed0, fetch_list=[loss])
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            (l,) = exe.run(prog, feed=feed0, fetch_list=[loss],
+                           return_numpy=False)
+        l = float(np.asarray(l))
+        t_staged = (time.perf_counter() - t0) / steps
+
+        rate = float(os.environ.get("BENCH_OVERLAP_RATE", 0.9))
+        pool = [feed0] + [
+            {k: jax.device_put(v) for k, v in cfg["feed"].items()}
+            for _ in range(3)
+        ]
+
+        def reader():
+            for i in itertools.count():
+                _time.sleep(rate * t_staged)  # synthetic read+decode+h2d
+                yield pool[i % len(pool)]
+
+        it = iter(DevicePrefetcher(reader, depth=2))
+        n = 0
+        t0 = time.perf_counter()
+        for feed in it:
+            (l,) = exe.run(prog, feed=feed, fetch_list=[loss],
+                           return_numpy=False)
+            n += 1
+            if n >= steps:
+                break
+        l = float(np.asarray(l))
+        t_pipe = (time.perf_counter() - t0) / n
+        eff = t_staged / t_pipe
+        print(json.dumps({
+            "metric": f"{cfg['metric']}_overlap_efficiency",
+            "value": round(eff, 3), "unit": "ratio",
+            "vs_baseline": None,
+            "staged_ms": round(t_staged * 1e3, 2),
+            "pipelined_ms": round(t_pipe * 1e3, 2),
+            "producer_rate": rate,
+        }))
+        return
 
     if os.environ.get("BENCH_PIPELINE") == "1":
         from paddle_tpu.data.feeder import DevicePrefetcher
