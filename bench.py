@@ -296,6 +296,22 @@ def run_all():
     print(json.dumps(head))
 
 
+def _timed_staged_steps(exe, prog, feed, loss, steps):
+    """The one staged-timing methodology (warmup, chained async steps,
+    final d2h readback) — shared by the headline path and BENCH_OVERLAP
+    so the two 'staged' numbers cannot drift apart."""
+    for _ in range(3):
+        (l,) = exe.run(prog, feed=feed, fetch_list=[loss])
+    assert np.isfinite(l), f"non-finite loss {l}"
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        (l,) = exe.run(prog, feed=feed, fetch_list=[loss],
+                       return_numpy=False)
+    l = float(np.asarray(l))
+    assert np.isfinite(l), f"non-finite loss {l}"
+    return (time.perf_counter() - t0) / steps
+
+
 def main():
     batch = int(os.environ.get("BENCH_BATCH", 128))
     steps = int(os.environ.get("BENCH_STEPS", 40))
@@ -326,32 +342,39 @@ def main():
         # hands out pre-staged device buffers — measuring whether the
         # overlap hides a producer that is faster than the step.
         import itertools
-        import time as _time
 
         from paddle_tpu.data.feeder import DevicePrefetcher
 
         feed0 = {k: jax.device_put(v) for k, v in cfg["feed"].items()}
-        for _ in range(3):
-            (l,) = exe.run(prog, feed=feed0, fetch_list=[loss])
-        t0 = time.perf_counter()
-        for _ in range(steps):
-            (l,) = exe.run(prog, feed=feed0, fetch_list=[loss],
-                           return_numpy=False)
-        l = float(np.asarray(l))
-        t_staged = (time.perf_counter() - t0) / steps
+        t_staged = _timed_staged_steps(exe, prog, feed0, loss, steps)
 
         rate = float(os.environ.get("BENCH_OVERLAP_RATE", 0.9))
         pool = [feed0] + [
             {k: jax.device_put(v) for k, v in cfg["feed"].items()}
             for _ in range(3)
         ]
+        # device_put is async and block_until_ready is a no-op on the
+        # tunnel (PERF.md pitfall #1): force EVERY pool transfer (all
+        # pytree leaves) to finish NOW via a device-side index + scalar
+        # readback, or the 77 MB h2d transfers drain inside the timed
+        # region
+        for f in pool:
+            for v in f.values():
+                for leaf in jax.tree.leaves(v):
+                    np.asarray(leaf.ravel()[0])
 
         def reader():
             for i in itertools.count():
-                _time.sleep(rate * t_staged)  # synthetic read+decode+h2d
+                time.sleep(rate * t_staged)  # synthetic read+decode+h2d
                 yield pool[i % len(pool)]
 
         it = iter(DevicePrefetcher(reader, depth=2))
+        # prime the pipeline: the first batch pays a full producer sleep
+        # that no steady-state iteration pays; timing it would charge the
+        # fill to the overlap machinery
+        first = next(it)
+        (l,) = exe.run(prog, feed=first, fetch_list=[loss],
+                       return_numpy=False)
         n = 0
         t0 = time.perf_counter()
         for feed in it:
@@ -398,23 +421,11 @@ def main():
     else:
         # stage the batch on device once: training input pipelines prefetch
         # to device (paddle_tpu/data/feeder.py); per-step host→device
-        # transfer would measure the PCIe/tunnel link, not the chip
+        # transfer would measure the PCIe/tunnel link, not the chip.
+        # _timed_staged_steps: warmup, chained async steps, one final d2h
+        # readback forcing the whole chain (no per-step host sync)
         feed = {k: jax.device_put(v) for k, v in cfg["feed"].items()}
-
-        # warmup (compile + first steps)
-        for _ in range(3):
-            (l,) = exe.run(prog, feed=feed, fetch_list=[loss])
-        assert np.isfinite(l), f"non-finite loss {l}"
-
-        t0 = time.perf_counter()
-        for _ in range(steps):
-            (l,) = exe.run(prog, feed=feed, fetch_list=[loss],
-                           return_numpy=False)
-        # d2h read of the final loss forces completion of the whole step
-        # chain (each step's update feeds the next); no per-step host sync
-        l = float(np.asarray(l))
-        dt = time.perf_counter() - t0
-        assert np.isfinite(l), f"non-finite loss {l}"
+        dt = _timed_staged_steps(exe, prog, feed, loss, steps) * steps
 
     items_per_sec = cfg["items_per_step"] * steps / dt
     mfu = items_per_sec * cfg["flops_per_item"] / PEAK_FLOPS

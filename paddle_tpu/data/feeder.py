@@ -94,6 +94,18 @@ class DevicePrefetcher:
         stop = threading.Event()
         END, ERR = object(), object()
 
+        def put(v):
+            # an array already on the target device must pass through:
+            # re-putting a committed device array round-trips its bytes
+            # through the host (on the tunneled platform that is ~0.7 s
+            # for a ResNet batch — measured via BENCH_OVERLAP before this
+            # guard existed)
+            if isinstance(v, jax.Array) and (
+                self.device is None or v.devices() == {self.device}
+            ):
+                return v
+            return jax.device_put(v, self.device)
+
         def produce():
             try:
                 for batch in self.reader():
@@ -101,8 +113,7 @@ class DevicePrefetcher:
                         return
                     feed = self.feeder.feed(batch) if self.feeder else batch
                     feed = {
-                        k: jax.device_put(v, self.device)
-                        for k, v in feed.items()
+                        k: jax.tree.map(put, v) for k, v in feed.items()
                     }
                     q.put(feed)
                 q.put(END)
