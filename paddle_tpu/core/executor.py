@@ -341,11 +341,7 @@ class Executor:
             fn = cached[1]
 
         state = {n: scope.get(n) for n in persist_names}
-        seed = jnp.asarray(
-            np.random.randint(0, 2**31 - 1) if program.random_seed == 0
-            else program.random_seed,
-            dtype=jnp.uint32,
-        )
+        seed = jnp.asarray(self._draw_seed(program), dtype=jnp.uint32)
         state, feed, seed = self._place_inputs(program, state, feed, seed)
         with self._device_context():
             fetches, new_state = fn(state, feed, seed)
@@ -364,6 +360,26 @@ class Executor:
                 np.asarray(f) if not isinstance(f, LoDArray) else f for f in fetches
             ]
         return fetches
+
+    # ------------------------------------------------------------------
+    def _draw_seed(self, program) -> int:
+        """Per-run RNG seed for dropout etc. (fresh when random_seed==0).
+        Hook: the multi-process ParallelExecutor must return the SAME
+        value on every process — SPMD programs diverge otherwise."""
+        return (
+            np.random.randint(0, 2**31 - 1) if program.random_seed == 0
+            else program.random_seed
+        )
+
+    # ------------------------------------------------------------------
+    def run_startup(self, program, scope=None):
+        """Run a startup (init) program. Same as run() here; the
+        ParallelExecutor overrides this to init on the local device —
+        parameters land on the mesh via _place_inputs at the first
+        parallel step, and a mesh-shaped compile of the init program
+        would have to declare output shardings for values that do not
+        exist yet."""
+        return self.run(program, scope=scope)
 
     # ------------------------------------------------------------------
     def _place_inputs(self, program, state, feed, seed):

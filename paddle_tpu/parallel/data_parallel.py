@@ -146,6 +146,65 @@ class ParallelExecutor(Executor):
         seed = put(seed, NamedSharding(self.mesh, PartitionSpec()))
         return state, feed, seed
 
+    def run_startup(self, program, scope=None):
+        """Parameter init runs single-device; every process must produce
+        the SAME host values (asserted by the cross-process device_put on
+        the first parallel step), so an unseeded init program gets one
+        chief-broadcast seed instead of per-host np.random draws —
+        without this, default-seed multi-process init diverges and dies
+        with an opaque assert at the first step."""
+        restore = None
+        if self._multiprocess and getattr(program, "random_seed", 0) == 0:
+            from jax.experimental import multihost_utils
+
+            seed = int(multihost_utils.broadcast_one_to_all(
+                np.uint32(np.random.randint(1, 2**31 - 1))
+            ))
+            restore, program.random_seed = 0, seed
+        try:
+            return Executor(self.place).run(program, scope=scope)
+        finally:
+            if restore is not None:
+                program.random_seed = restore
+
+    def _draw_seed(self, program) -> int:
+        """Every process must use the SAME per-run seed (the seed scalar
+        is device_put across processes, and SPMD dropout masks must
+        agree): broadcast one base from the chief once, then advance a
+        local counter — all processes call run() in lockstep, so the
+        sequence stays aligned without a per-step collective."""
+        if not self._multiprocess or program.random_seed != 0:
+            return Executor._draw_seed(self, program)
+        if not hasattr(self, "_seed_base"):
+            from jax.experimental import multihost_utils
+
+            self._seed_base = int(multihost_utils.broadcast_one_to_all(
+                np.uint32(np.random.randint(1, 2**30))
+            ))
+            self._seed_calls = 0
+        self._seed_calls += 1
+        return (self._seed_base + self._seed_calls) % (2**31 - 1)
+
+    def run(self, program=None, feed=None, fetch_list=None, scope=None,
+            return_numpy=True):
+        """Init-style programs (they CREATE persistables the scope does
+        not hold yet) cannot be mesh-compiled — the output tree would
+        have to declare shardings for values that don't exist — so the
+        documented `exe.run(startup_program)` idiom delegates to the
+        local-device startup path instead of dying in a pytree error."""
+        from ..core.executor import global_scope
+        from ..core.program import default_main_program
+
+        prog = program or default_main_program()
+        scope_ = scope or global_scope()
+        creates_new = any(
+            not scope_.has(v.name) for v in prog.persistables()
+        )
+        if creates_new and not feed and not fetch_list:
+            return self.run_startup(prog, scope=scope_)
+        return super().run(prog, feed=feed, fetch_list=fetch_list,
+                           scope=scope_, return_numpy=return_numpy)
+
     def _cache_key_prefix(self) -> tuple:
         return ("par", id(self.mesh))
 

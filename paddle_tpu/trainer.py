@@ -18,6 +18,7 @@ checkpointing design parity, §5.3/§5.4 of SURVEY.md).
 
 from __future__ import annotations
 
+import logging
 import time
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
@@ -85,11 +86,17 @@ class CheckpointConfig:
         epoch_interval: int = 1,
         step_interval: int = 0,
         max_num_checkpoints: int = 3,
+        sharded: bool = False,
     ):
         self.checkpoint_dir = checkpoint_dir
         self.epoch_interval = epoch_interval
         self.step_interval = step_interval
         self.max_num_checkpoints = max_num_checkpoints
+        # orbax-style per-shard format: each process writes only the
+        # shards it owns (required for multi-process training — a plain
+        # gathered npz would race across writers and cannot read
+        # non-addressable arrays)
+        self.sharded = sharded
 
 
 class Trainer:
@@ -128,7 +135,7 @@ class Trainer:
         """Run startup (parameter init), or resume from the newest checkpoint
         if checkpoint_config points at one (init_model_path/start_pass
         parity, ParamUtil.h:105-111)."""
-        self.exe.run(self.startup_program, scope=self.scope)
+        self.exe.run_startup(self.startup_program, scope=self.scope)
         cc = self.checkpoint_config
         if cc and io.get_latest_checkpoint_serial(cc.checkpoint_dir) >= 0:
             args = io.load_checkpoint(
@@ -310,16 +317,35 @@ class Trainer:
 
     # -- checkpointing ------------------------------------------------------
     def _save_checkpoint(self, pass_id: int, batch_id: Optional[int] = None) -> None:
+        import jax
+
         cc = self.checkpoint_config
         args = {"pass_id": pass_id, "step": self.step, "time": time.time()}
         if batch_id is not None:
             args.update({"mid_pass": True, "batch_id": batch_id})
+        sharded = getattr(cc, "sharded", False)
+        if not sharded and jax.process_count() > 1:
+            # a gathered single-file save cannot read non-addressable
+            # arrays and would race across writers; the per-shard format
+            # is the only correct multi-process layout, so upgrade loudly
+            # — once, from the chief (not every process on every save)
+            if jax.process_index() == 0 and not getattr(
+                self, "_warned_sharded_upgrade", False
+            ):
+                self._warned_sharded_upgrade = True
+                logging.getLogger("paddle_tpu.trainer").warning(
+                    "multi-process run: upgrading checkpoint save to the "
+                    "sharded format (set CheckpointConfig(sharded=True) "
+                    "to silence this)"
+                )
+            sharded = True
         io.save_checkpoint(
             cc.checkpoint_dir,
             trainer_args=args,
             main_program=self.main_program,
             scope=self.scope,
             max_num_checkpoints=cc.max_num_checkpoints,
+            sharded=sharded,
         )
 
     def save_params(self, dirname: str) -> None:
