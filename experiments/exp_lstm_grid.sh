@@ -1,0 +1,15 @@
+#!/bin/bash
+# The reference's FULL published LSTM grid (benchmark/README.md:113-136):
+# hidden 256/512/1280 x batch 64/128/256, seq 100 — one row each through
+# bench.py so vs_baseline lands against the matching K40m cell.
+cd /root/repo
+OUT=benchmarks/lstm_grid.jsonl
+: > $OUT
+for H in 256 512 1280; do
+  for B in 64 128 256; do
+    line=$(timeout 900 env BENCH_MODEL=lstm BENCH_HIDDEN=$H BENCH_BATCH=$B python bench.py 2>/dev/null | tail -1)
+    echo "{\"hidden\": $H, \"batch\": $B, \"row\": $line}" >> $OUT
+    echo "h$H b$B: $line"
+  done
+done
+echo DONE

@@ -54,8 +54,12 @@ def _backend_ok() -> bool:
 # overflow; GRU f32 H=1280 B=128 → 25.6M vs observed 25.0M overflow;
 # LSTM bf16 H=1280 B=256 → 24.2M vs the microbench fused_error row;
 # GRU bf16 H=1280 B=128 → 14.7M, compiles and wins 1.88x
-# (benchmarks/rnn_kernel_microbench.json).
-_VMEM_BUDGET = 16 * 1024 * 1024
+# (benchmarks/rnn_kernel_microbench.json). The budget keeps a 1M safety
+# margin below the hardware's 16M: LSTM bf16 H=1280 B=64 models at 15.9M
+# and was observed BOTH compiling (152k tok/s) and overflowing by 824K
+# on different compiles of the same graph — borderline configs flip with
+# the compiler's scratch scheduling, so they stay on the scan.
+_VMEM_BUDGET = 15 * 1024 * 1024
 
 
 def _bwd_vmem_bytes(B: int, H: int, G: int, itemsize: int,

@@ -131,7 +131,10 @@ def test_support_gating(monkeypatch):
     # fits at B=64 but not B=128 (observed train-graph overflow), and the
     # f32 weight block alone busts the budget at H=1280
     assert not pallas_kernels.lstm_supported(8, 256, "sigmoid", "tanh", "tanh", None)
-    assert pallas_kernels.lstm_supported(64, 1280, "sigmoid", "tanh", "tanh", None)
+    assert pallas_kernels.lstm_supported(32, 1280, "sigmoid", "tanh", "tanh", None)
+    # B=64 H=1280 bf16 models at 15.9M: observed flipping between
+    # compiling and overflowing on different compiles — excluded
+    assert not pallas_kernels.lstm_supported(64, 1280, "sigmoid", "tanh", "tanh", None)
     assert not pallas_kernels.lstm_supported(128, 1280, "sigmoid", "tanh", "tanh", None)
     assert pallas_kernels.lstm_supported(128, 1024, "sigmoid", "tanh", "tanh", None)
     assert not pallas_kernels.lstm_supported(
