@@ -250,11 +250,14 @@ def _build_transformer_train(batch):
 
 
 # per-model env for the BENCH_MODEL=all sweep: the measured-best one-chip
-# config of each headline model (PERF.md round 3)
+# config of each headline model (PERF.md round 3). Step counts keep every
+# timed region >= 2 s of chained device work (methodology rule: shorter
+# regions measure tunnel RTT jitter — the fast recurrence benches at the
+# default 40 steps chained only ~0.5 s and swung with the link)
 _ALL_MODELS = [
     ("resnet", {}),
-    ("lstm", {}),
-    ("nmt", {}),
+    ("lstm", {"BENCH_STEPS": "200"}),
+    ("nmt", {"BENCH_STEPS": "100"}),
     ("transformer", {"BENCH_HIDDEN": "2048", "BENCH_DEPTH": "8",
                      "BENCH_BATCH": "8", "BENCH_REMAT": "full"}),
 ]
