@@ -43,6 +43,19 @@ def mnist():
         _gz_write(os.path.join(d, f"{split}-labels-idx1-ubyte.gz"), lbl_payload)
 
 
+def _tar_gz(path, members):
+    """Byte-stable .tar.gz: gzip mtime=0 and zeroed TarInfo timestamps
+    (tarfile's "w:gz" would embed wall-clock time)."""
+    buf = io.BytesIO()
+    with tarfile.open(fileobj=buf, mode="w") as tf:
+        for name, payload in members:
+            info = tarfile.TarInfo(name)
+            info.size = len(payload)
+            info.mtime = 0
+            tf.addfile(info, io.BytesIO(payload))
+    _gz_write(path, buf.getvalue())
+
+
 def cifar():
     d = os.path.join(ROOT, "cifar")
     os.makedirs(d, exist_ok=True)
@@ -52,13 +65,11 @@ def cifar():
         return {"data": rng.randint(0, 256, (n, 3072), dtype=np.uint8),
                 "labels": [(i + off) % 10 for i in range(n)]}
 
-    with tarfile.open(os.path.join(d, "cifar-10-python.tar.gz"), "w:gz") as tf:
-        for name, b in (("cifar-10-batches-py/data_batch_1", batch(8, 0)),
-                        ("cifar-10-batches-py/test_batch", batch(4, 3))):
-            payload = pickle.dumps(b, protocol=2)
-            info = tarfile.TarInfo(name)
-            info.size = len(payload)
-            tf.addfile(info, io.BytesIO(payload))
+    _tar_gz(os.path.join(d, "cifar-10-python.tar.gz"),
+            [("cifar-10-batches-py/data_batch_1",
+              pickle.dumps(batch(8, 0), protocol=2)),
+             ("cifar-10-batches-py/test_batch",
+              pickle.dumps(batch(4, 3), protocol=2))])
 
 
 def imdb():
@@ -100,18 +111,13 @@ def wmt14():
              ("le chat dort ici", "the cat sleeps here"),
              ("le chien mange ici", "the dog eats here"),
              ("le chat mange ici", "the cat eats here")]
-    with tarfile.open(os.path.join(d, "wmt14.tgz"), "w:gz") as tf:
-        def add(name, text):
-            payload = text.encode()
-            info = tarfile.TarInfo(name)
-            info.size = len(payload)
-            tf.addfile(info, io.BytesIO(payload))
-
-        add("wmt14/src.dict", "\n".join(src_vocab) + "\n")
-        add("wmt14/trg.dict", "\n".join(trg_vocab) + "\n")
-        add("wmt14/train/train",
-            "\n".join(f"{s}\t{t}" for s, t in pairs[:4]) + "\n")
-        add("wmt14/test/test", f"{pairs[4][0]}\t{pairs[4][1]}\n")
+    _tar_gz(os.path.join(d, "wmt14.tgz"), [
+        ("wmt14/src.dict", ("\n".join(src_vocab) + "\n").encode()),
+        ("wmt14/trg.dict", ("\n".join(trg_vocab) + "\n").encode()),
+        ("wmt14/train/train",
+         ("\n".join(f"{s}\t{t}" for s, t in pairs[:4]) + "\n").encode()),
+        ("wmt14/test/test", f"{pairs[4][0]}\t{pairs[4][1]}\n".encode()),
+    ])
 
 
 def uci_housing():
@@ -134,10 +140,12 @@ def movielens():
     rng = np.random.RandomState(4)
     ratings = [f"{u}::{m}::{rng.randint(1, 6)}::97830{u}{m}"
                for u in (1, 2, 3) for m in (1, 2, 3)]
+    epoch = (1980, 1, 1, 0, 0, 0)  # fixed timestamps: byte-stable zip
     with zipfile.ZipFile(os.path.join(d, "ml-1m.zip"), "w") as z:
-        z.writestr("ml-1m/users.dat", "\n".join(users) + "\n")
-        z.writestr("ml-1m/movies.dat", "\n".join(movies) + "\n")
-        z.writestr("ml-1m/ratings.dat", "\n".join(ratings) + "\n")
+        for name, text in (("ml-1m/users.dat", "\n".join(users) + "\n"),
+                           ("ml-1m/movies.dat", "\n".join(movies) + "\n"),
+                           ("ml-1m/ratings.dat", "\n".join(ratings) + "\n")):
+            z.writestr(zipfile.ZipInfo(name, date_time=epoch), text)
 
 
 if __name__ == "__main__":
