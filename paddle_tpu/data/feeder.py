@@ -100,11 +100,15 @@ class DevicePrefetcher:
             # through the host (on the tunneled platform that is ~0.7 s
             # for a ResNet batch — measured via BENCH_OVERLAP before this
             # guard existed)
-            if isinstance(v, jax.Array) and (
-                self.device is None or v.devices() == {self.device}
-            ):
+            # device=None means "the effective default device" — resolve it
+            # so an array committed to a DIFFERENT device still gets placed
+            # (jax.device_put(x, None) is the identity for committed arrays)
+            target = self.device
+            if target is None:
+                target = jax.config.jax_default_device or jax.devices()[0]
+            if isinstance(v, jax.Array) and v.devices() == {target}:
                 return v
-            return jax.device_put(v, self.device)
+            return jax.device_put(v, target)
 
         def produce():
             try:

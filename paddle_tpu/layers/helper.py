@@ -77,7 +77,9 @@ class LayerHelper:
             # the hook's mask init must follow the param's init op
             param.update_hooks = list(hooks)
             for hook in param.update_hooks:
-                hook.append_startup(param, self.block, self.startup_program)
+                # the mask lives in the global block (params do too) so the
+                # update-time lookup works for layers built in sub-blocks
+                hook.append_startup(param, gb, self.startup_program)
         return param
 
     def create_tmp_variable(self, dtype=np.float32, shape=(), lod_level=0) -> Variable:

@@ -102,6 +102,12 @@ def _v5e_block_sizes(Tq: int, Tk: int):
     from jax.experimental.pallas.ops.tpu.flash_attention import BlockSizes
 
     def blk(T):
+        if T % 128:
+            # _flash_kernel is gate-free (benchmarks call it directly);
+            # without this check b would decrement to 0 and `T % 0` raise
+            raise ValueError(
+                f"flash kernel requires a 128-aligned sequence, got T={T}"
+            )
         b = min(T, 512 if T < 8192 else 1024)
         while T % b:
             b -= 128

@@ -276,8 +276,13 @@ def prune_mask_init_kernel(ctx):
     if k <= 0:
         ctx.set_output("Out", jnp.ones_like(w))
         return
-    thr = jnp.sort(flat)[k - 1]
-    ctx.set_output("Out", (jnp.abs(w) > thr).astype(w.dtype))
+    # Exactly-k selection by sorted index (the reference partial_sorts
+    # indices): a |w| > threshold compare would also prune every value
+    # tied at the threshold — a constant-magnitude init would mask to
+    # all-zero.
+    order = jnp.argsort(flat)
+    mask = jnp.ones(flat.shape, w.dtype).at[order[:k]].set(0)
+    ctx.set_output("Out", mask.reshape(w.shape))
 
 
 @register_op("apply_mask")

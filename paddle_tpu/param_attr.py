@@ -49,6 +49,14 @@ class StaticPruningHook:
             outputs={"Out": [mask.name]},
             attrs={"sparsity_ratio": float(self.sparsity_ratio)},
         )
+        # Reference StaticPruningHook::init masks the param immediately
+        # after generateMask (paraVec->dotMul(maskVec_)); without this the
+        # first forward runs unpruned until the first optimizer step.
+        sb.append_op(
+            "apply_mask",
+            inputs={"Param": [param.name], "Mask": [mask.name]},
+            outputs={"ParamOut": [param.name]},
+        )
 
     def append_update(self, helper, param) -> None:
         mask = helper.main_program.global_block().var(self.mask_name(param))

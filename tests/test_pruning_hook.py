@@ -80,3 +80,41 @@ def test_pruning_mask_threshold_semantics():
     kept = np.abs(w[mask == 1])
     dropped = np.abs(w[mask == 0])
     assert kept.min() > dropped.max()  # magnitude criterion, no mixing
+
+
+def test_pruning_masks_param_at_startup():
+    """Reference StaticPruningHook::init dotMuls the mask into the param
+    immediately — the very first forward must already be pruned, before
+    any optimizer step."""
+    pt.reset()
+    pt.default_startup_program().random_seed = 13
+    _build(sparsity=0.75)
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    scope = pt.core.executor.global_scope()
+    w = np.asarray(scope.get("w_pruned"))
+    mask = np.asarray(scope.get("w_pruned@PRUNE_MASK"))
+    assert np.all(w[mask == 0] == 0.0)
+
+
+def test_pruning_exact_k_under_ties():
+    """A constant-magnitude init ties every |w| at the threshold; the
+    reference selects exactly nonZeroNum survivors (partial_sort on
+    indices), never masking the whole parameter."""
+    pt.reset()
+    x = pt.layers.data("x", shape=[16])
+    h = pt.layers.fc(
+        x, size=32, act=None,
+        param_attr=pt.ParamAttr(
+            name="w_tied",
+            initializer=pt.initializer.Constant(0.5),
+            update_hooks=[pt.StaticPruningHook(sparsity_ratio=0.75)],
+        ),
+        bias_attr=False,
+    )
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    scope = pt.core.executor.global_scope()
+    mask = np.asarray(scope.get("w_tied@PRUNE_MASK"))
+    assert (mask == 0).sum() == int(round(0.75 * mask.size))
+    assert (mask == 1).sum() == mask.size - int(round(0.75 * mask.size))
