@@ -1,0 +1,89 @@
+"""Ablation: where does the fused conv+BN protocol's time go?
+
+Variants (same process, interleaved):
+  unfused        — baseline conv2d+batch_norm graph
+  jnp-protocol   — raw-stats protocol ops, Pallas disabled (XLA math):
+                   isolates the graph-restructure cost
+  pallas         — the full fused path
+Each timed fwd-only and full-train.
+
+Run on TPU: python experiments/exp_fusedresnet2.py
+"""
+import os
+import time
+
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu import models
+from paddle_tpu.flags import FLAGS
+
+BATCH = int(os.environ.get("BATCH", 128))
+STEPS = int(os.environ.get("STEPS", 30))
+
+
+def build(fused, train, no_pallas):
+    FLAGS.use_fused_conv = fused
+    prog, startup = pt.Program(), pt.Program()
+    startup.random_seed = 7
+    with pt.program_guard(prog, startup):
+        img = pt.layers.data("img", shape=[224, 224, 3])
+        label = pt.layers.data("label", shape=[1], dtype=np.int32)
+        logits = models.resnet_imagenet(img, class_dim=1000,
+                                        data_format="NHWC")
+        loss = pt.layers.mean(
+            pt.layers.softmax_with_cross_entropy(logits, label))
+        if train:
+            pt.optimizer.Momentum(learning_rate=0.1,
+                                  momentum=0.9).minimize(loss)
+    prog.set_amp("bfloat16")
+    return prog, startup, loss, no_pallas
+
+
+def main():
+    import jax
+
+    from paddle_tpu.ops import fused_conv_ops as fco
+
+    real_eligible = fco.fused_conv_eligible
+    rng = np.random.RandomState(0)
+    feed = {
+        "img": rng.randn(BATCH, 224, 224, 3).astype(np.float32),
+        "label": rng.randint(0, 1000, (BATCH, 1)).astype(np.int32),
+    }
+    feed = {k: jax.device_put(v) for k, v in feed.items()}
+    for v in feed.values():
+        np.asarray(v.ravel()[0])
+
+    variants = {}
+    for train in (False, True):
+        t = "train" if train else "fwd"
+        variants[f"unfused-{t}"] = build(False, train, False)
+        variants[f"jnpproto-{t}"] = build(True, train, True)
+        variants[f"pallas-{t}"] = build(True, train, False)
+
+    exe = pt.Executor(donate_state=True)
+    for name, (prog, startup, loss, no_pallas) in variants.items():
+        fco.fused_conv_eligible = (
+            (lambda *a, **k: False) if no_pallas else real_eligible)
+        exe.run(startup)
+        for _ in range(2):
+            (l,) = exe.run(prog, feed=feed, fetch_list=[loss])
+        assert np.isfinite(l), f"{name}: loss {l}"
+        print(f"compiled {name}: loss {float(l):.4f}", flush=True)
+
+    for rep in range(2):
+        for name, (prog, startup, loss, no_pallas) in variants.items():
+            fco.fused_conv_eligible = (
+                (lambda *a, **k: False) if no_pallas else real_eligible)
+            t0 = time.perf_counter()
+            for _ in range(STEPS):
+                (l,) = exe.run(prog, feed=feed, fetch_list=[loss],
+                               return_numpy=False)
+            float(np.asarray(l))
+            dt = (time.perf_counter() - t0) / STEPS
+            print(f"rep{rep} {name}: {dt*1e3:.1f} ms/step", flush=True)
+
+
+if __name__ == "__main__":
+    main()

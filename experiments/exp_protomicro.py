@@ -1,0 +1,157 @@
+"""Per-stage microbench: 1x1-conv+BN chain in four formulations.
+
+  convgen : lax.conv_general_dilated + jnp mean/var BN   (framework baseline)
+  dot     : reshape+jnp.dot + jnp mean/var BN            (exp_fusedbn's "XLA")
+  proto   : raw-stats protocol in pure jnp (_jnp_fused)
+  pallas  : raw-stats protocol through the Pallas kernel
+
+exp_fusedbn measured pallas 1.15x over *dot* — this decides whether that
+was a strawman (convgen faster than dot) and where the in-model 2x fwd
+regression comes from. Run on TPU: python experiments/exp_protomicro.py
+"""
+import functools
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.ops.fused_conv_ops import (_fused_fn, _jnp_fused,
+                                           fused_conv_eligible)
+
+L = 6
+REPS = 10
+
+# (B, HW, Cin, Cout) — ResNet-50 bs128 stage shapes (the two 1x1 convs of
+# each bottleneck) + the stage-2 small-channel pair
+SHAPES = [
+    (128, 56, 256, 64),
+    (128, 56, 64, 256),
+    (128, 28, 512, 128),
+    (128, 28, 128, 512),
+    (128, 14, 1024, 256),
+    (128, 14, 256, 1024),
+    (128, 7, 2048, 512),
+    (128, 7, 512, 2048),
+]
+
+
+def timeit(f, *args):
+    r = f(*args)
+    np.asarray(jax.tree.leaves(r)[0].ravel()[0])
+    t0 = time.perf_counter()
+    r = f(*args)
+    np.asarray(jax.tree.leaves(r)[0].ravel()[0])
+    return (time.perf_counter() - t0) / REPS
+
+
+def many(f):
+    @jax.jit
+    def run(x):
+        def body(xc, _):
+            l = f(xc)
+            return xc + jnp.asarray(1e-12, xc.dtype) * l.astype(xc.dtype), l
+        _, ls = jax.lax.scan(body, x, None, length=REPS)
+        return ls[-1]
+    return run
+
+
+def bn_relu(y, g, b):
+    yf = y.astype(jnp.float32)
+    m = jnp.mean(yf, axis=0)
+    v = jnp.var(yf, axis=0)
+    out = (yf - m) * jax.lax.rsqrt(v + 1e-5) * g + b
+    return jnp.maximum(out, 0.0).astype(y.dtype)
+
+
+def chain_convgen(x4, ws, gs, bs):
+    # x4 [B, H, W, C]; ws[k] [Cin, Cout] -> HWIO [1,1,Cin,Cout]
+    for k in range(L):
+        w = ws[k][None, None]
+        y = jax.lax.conv_general_dilated(
+            x4, w, (1, 1), [(0, 0), (0, 0)],
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        yf = y.astype(jnp.float32)
+        m = jnp.mean(yf, axis=(0, 1, 2))
+        v = jnp.var(yf, axis=(0, 1, 2))
+        out = (yf - m) * jax.lax.rsqrt(v + 1e-5) * gs[k] + bs[k]
+        x4 = jnp.maximum(out, 0.0).astype(y.dtype)
+    return jnp.sum(x4.astype(jnp.float32))
+
+
+def chain_dot(x, ws, gs, bs):
+    for k in range(L):
+        y = jnp.dot(x, ws[k])
+        x = bn_relu(y, gs[k], bs[k])
+    return jnp.sum(x.astype(jnp.float32))
+
+
+def _chain_proto(x, ws, gs, bs, unit):
+    pm = pi = None
+    g_prev = b_prev = None
+    for k in range(L):
+        if pm is None:
+            y, s, sq = unit(x, ws[k], None, None, None, None, False)
+        else:
+            y, s, sq = unit(x, ws[k], pm, pi, g_prev, b_prev, True)
+        n = float(y.shape[0])
+        m = s / n
+        v = jnp.maximum(sq / n - m * m, 0.0)
+        pm, pi = m, jax.lax.rsqrt(v + 1e-5)
+        g_prev, b_prev = gs[k], bs[k]
+        x = y
+    # final normalize folded into readout
+    return jnp.sum(((x.astype(jnp.float32) - pm) * pi * g_prev + b_prev))
+
+
+def unit_jnp(x, w, pm, pi, ps, pb, prologue):
+    return _jnp_fused(x, w, pm, pi, ps, pb, prologue, True)
+
+
+def unit_pallas(x, w, pm, pi, ps, pb, prologue):
+    if not prologue:
+        c = x.shape[1]
+        pm = jnp.zeros((c,), jnp.float32)
+        pi = jnp.ones((c,), jnp.float32)
+        ps = jnp.ones((c,), jnp.float32)
+        pb = jnp.zeros((c,), jnp.float32)
+    f = _fused_fn(prologue, True, False)
+    return f(x, w, pm, pi, ps, pb)
+
+
+def main():
+    for (B, HW, Cin, Cout) in SHAPES:
+        N = B * HW * HW
+        rng = np.random.RandomState(0)
+        x = jnp.asarray(rng.randn(N, Cin) * 0.5, jnp.bfloat16)
+        x4 = x.reshape(B, HW, HW, Cin)
+        # alternate Cin->Cout->Cin so the chain is shape-stable
+        ws, gs, bs = [], [], []
+        for k in range(L):
+            ci, co = (Cin, Cout) if k % 2 == 0 else (Cout, Cin)
+            ws.append(jnp.asarray(rng.randn(ci, co) / np.sqrt(ci),
+                                  jnp.bfloat16))
+            gs.append(jnp.ones((co,), jnp.float32))
+            bs.append(jnp.zeros((co,), jnp.float32))
+        flops = sum(2 * N * w.shape[0] * w.shape[1] for w in ws) * REPS
+
+        res = {}
+        res["convgen"] = timeit(many(
+            lambda a: chain_convgen(a.reshape(B, HW, HW, Cin), ws, gs, bs)
+        ), x)
+        res["dot"] = timeit(many(lambda a: chain_dot(a, ws, gs, bs)), x)
+        res["proto"] = timeit(many(
+            lambda a: _chain_proto(a, ws, gs, bs, unit_jnp)), x)
+        eligible = fused_conv_eligible(N, Cin, Cout, jnp.bfloat16) and \
+            fused_conv_eligible(N, Cout, Cin, jnp.bfloat16)
+        if eligible:
+            res["pallas"] = timeit(many(
+                lambda a: _chain_proto(a, ws, gs, bs, unit_pallas)), x)
+        line = f"N={N:6d} {Cin:4d}->{Cout:4d}: "
+        for k, t in res.items():
+            line += f"{k}={t*1e3:6.2f}ms ({flops/t/1e12:5.1f}TF/s)  "
+        print(line, flush=True)
+
+
+if __name__ == "__main__":
+    main()

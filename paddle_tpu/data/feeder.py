@@ -101,12 +101,21 @@ class DevicePrefetcher:
             # for a ResNet batch — measured via BENCH_OVERLAP before this
             # guard existed)
             # device=None means "the effective default device" — resolve it
-            # so an array committed to a DIFFERENT device still gets placed
-            # (jax.device_put(x, None) is the identity for committed arrays)
+            # so an array committed to a DIFFERENT local device still gets
+            # placed (jax.device_put(x, None) is the identity for committed
+            # arrays). Resolution handles a string jax_default_device and
+            # stays process-local; multi-device (sharded) arrays pass
+            # through untouched — re-placing them would gather.
             target = self.device
             if target is None:
-                target = jax.config.jax_default_device or jax.devices()[0]
-            if isinstance(v, jax.Array) and v.devices() == {target}:
+                target = jax.config.jax_default_device
+                if isinstance(target, str):
+                    target = jax.local_devices(backend=target)[0]
+                elif target is None:
+                    target = jax.local_devices()[0]
+            if isinstance(v, jax.Array) and (
+                len(v.devices()) > 1 or v.devices() == {target}
+            ):
                 return v
             return jax.device_put(v, target)
 

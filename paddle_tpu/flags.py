@@ -135,3 +135,40 @@ define_flag("use_fused_rnn", True,
 define_flag("fused_rnn_interpret", False,
             "testing only: allow the fused RNN kernels in pallas interpret "
             "mode on non-TPU backends")
+define_flag("use_fused_conv", True,
+            "build conv+BN+ReLU towers through the fused raw-stats protocol "
+            "(pallas 1x1-conv kernels with BN prologue/epilogue — the "
+            "reference's cuDNN fused-conv analogue, "
+            "gserver/layers/CudnnConvBaseLayer.cpp); ineligible shapes and "
+            "non-TPU backends fall back to identical-semantics jnp inside "
+            "the same ops")
+define_flag("fused_conv_dot_max_n", 0,
+            "run the protocol's 1x1 convs as 2-D matmuls (dot or pallas "
+            "per fused_conv_pallas) when rows N <= this. Default 0 (always "
+            "the 4-D conv_general formulation): measured in-model on v5e "
+            "(experiments/exp_dotstage.py) every threshold LOSES — dots in "
+            "a conv tower force relayouts that outweigh the dot's "
+            "isolated-chain win (exp_protomicro.py)")
+define_flag("fused_conv_pallas", False,
+            "use the hand-written Pallas fused kernel for eligible 2-D "
+            "dispatches (requires fused_conv_dot_max_n > 0). Off by "
+            "default: measured slower than XLA's own fusion of the same "
+            "raw-stats formulation at every ResNet stage shape "
+            "(experiments/exp_protomicro.py; see PERF.md round 4)")
+define_flag("fused_conv_interpret", False,
+            "testing only: allow the fused conv kernels in pallas interpret "
+            "mode on non-TPU backends")
+define_flag("use_fused_attention", True,
+            "use the fused Bahdanau attention decoder kernels when shapes "
+            "are eligible and the backend is TPU (ops/bahdanau_kernels.py "
+            "— the hand-written-fused-kernel philosophy of the reference's "
+            "hl_lstm.h:42 applied to the NMT decoder scan, 51% of that "
+            "step)")
+define_flag("fused_attention_interpret", False,
+            "testing only: allow the fused attention decoder kernels in "
+            "pallas interpret mode on non-TPU backends")
+define_flag("bn_bf16_stats", False,
+            "batch_norm stats: square in the io dtype with f32 reduction "
+            "accumulation instead of upcasting the activation first "
+            "(escape-route experiment, PERF.md r4: <1% effect at every "
+            "batch size — kept as a knob, off by default)")

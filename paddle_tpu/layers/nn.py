@@ -24,6 +24,10 @@ __all__ = [
     "conv2d_transpose",
     "pool2d",
     "batch_norm",
+    "fused_conv_bn",
+    "bn_stats",
+    "bn_apply",
+    "RawConvBN",
     "layer_norm",
     "dropout",
     "cross_entropy",
@@ -315,20 +319,10 @@ def pool2d(
     return out
 
 
-def batch_norm(
-    input,
-    act: Optional[str] = None,
-    momentum: float = 0.9,
-    epsilon: float = 1e-5,
-    is_test: bool = False,
-    param_attr=None,
-    bias_attr=None,
-    name=None,
-    data_format: str = "NCHW",
-) -> Variable:
-    """Reference: fluid layers/nn.py `batch_norm` / batch_norm_op.cc."""
-    helper = LayerHelper("batch_norm", name=name)
-    c = input.shape[1] if data_format == "NCHW" else input.shape[-1]
+def _create_bn_params(helper, c, param_attr=None, bias_attr=None):
+    """scale/bias trainables + running mean/variance persistables, in the
+    exact creation order batch_norm uses (shared with the fused conv path
+    so the two formulations produce identical checkpoint names)."""
     scale = helper.create_parameter(
         param_attr, (c,), default_initializer=ConstantInitializer(1.0)
     )
@@ -344,12 +338,29 @@ def batch_norm(
         default_initializer=ConstantInitializer(1.0),
     )
     # running stats are state, not trainable weights
-    mean.trainable = False
-    mean.is_parameter = False
-    mean.persistable = True
-    var.trainable = False
-    var.is_parameter = False
-    var.persistable = True
+    for v in (mean, var):
+        v.trainable = False
+        v.is_parameter = False
+        v.persistable = True
+    return scale, bias, mean, var
+
+
+def batch_norm(
+    input,
+    act: Optional[str] = None,
+    momentum: float = 0.9,
+    epsilon: float = 1e-5,
+    is_test: bool = False,
+    param_attr=None,
+    bias_attr=None,
+    name=None,
+    data_format: str = "NCHW",
+) -> Variable:
+    """Reference: fluid layers/nn.py `batch_norm` / batch_norm_op.cc."""
+    helper = LayerHelper("batch_norm", name=name)
+    c = input.shape[1] if data_format == "NCHW" else input.shape[-1]
+    scale, bias, mean, var = _create_bn_params(helper, c, param_attr,
+                                               bias_attr)
     out = helper.create_tmp_variable(input.dtype, input.shape)
     helper.append_op(
         type="batch_norm",
@@ -360,6 +371,122 @@ def batch_norm(
                "data_format": data_format},
     )
     return helper.append_activation(out, act)
+
+
+class RawConvBN:
+    """A raw (pre-BatchNorm) activation plus the stats/params needed to
+    normalize it — the currency of the fused conv+BN protocol
+    (ops/fused_conv_ops.py). Consumers either materialize the normalized
+    tensor (bn_apply: one fused elementwise pass) or hand the pair to the
+    next fused_conv_bn, which applies the normalize inside its Pallas
+    prologue (the activation is then never written normalized at all)."""
+
+    __slots__ = ("out", "mean", "inv", "scale", "bias")
+
+    def __init__(self, out, mean, inv, scale, bias):
+        self.out = out
+        self.mean = mean
+        self.inv = inv
+        self.scale = scale
+        self.bias = bias
+
+
+def fused_conv_bn(
+    input,
+    num_filters: int,
+    stride: int = 1,
+    prologue_act: Optional[str] = "relu",
+    momentum: float = 0.9,
+    epsilon: float = 1e-5,
+    param_attr=None,
+    bn_param_attr=None,
+    bn_bias_attr=None,
+    name=None,
+) -> RawConvBN:
+    """1x1 conv + BatchNorm through the fused raw-stats protocol (NHWC,
+    train mode). `input` is a Variable (normalized activation — no
+    prologue) or a RawConvBN (the previous BN's apply+act runs inside this
+    conv's kernel prologue). Returns this conv's RawConvBN.
+
+    Reference: the cuDNN fused conv machinery the reference's conv hot
+    path always runs through (gserver/layers/CudnnConvBaseLayer.cpp,
+    cuda/src/hl_cuda_cudnn.cc); parameter names match the unfused
+    conv2d+batch_norm sequence exactly so checkpoints interchange (the
+    eval-mode graph is built unfused)."""
+    prologue = isinstance(input, RawConvBN)
+    x = input.out if prologue else input
+    in_c = x.shape[3]
+    conv_helper = LayerHelper("conv2d")
+    std = (2.0 / in_c) ** 0.5
+    w = conv_helper.create_parameter(
+        param_attr, (num_filters, in_c, 1, 1),
+        default_initializer=NormalInitializer(0.0, std),
+    )
+    # `name` names the BN half (its helper owns the running mean/variance
+    # persistable names, which must match an unfused batch_norm's)
+    bn_helper = LayerHelper("batch_norm", name=name)
+    scale, bias, mean, var = _create_bn_params(
+        bn_helper, num_filters, bn_param_attr, bn_bias_attr)
+    out_hw = tuple(
+        -1 if d == -1 else (d + stride - 1) // stride for d in x.shape[1:3]
+    )
+    out = conv_helper.create_tmp_variable(
+        x.dtype, (-1,) + out_hw + (num_filters,))
+    bmean = conv_helper.create_tmp_variable(np.float32, (num_filters,))
+    binv = conv_helper.create_tmp_variable(np.float32, (num_filters,))
+    inputs = {"X": [x], "Filter": [w], "Mean": [mean], "Variance": [var]}
+    if prologue:
+        inputs.update({"XMean": [input.mean], "XInv": [input.inv],
+                       "XScale": [input.scale], "XBias": [input.bias]})
+    conv_helper.append_op(
+        type="fused_conv_bn",
+        inputs=inputs,
+        outputs={"Out": [out], "BatchMean": [bmean], "BatchInv": [binv]},
+        attrs={"stride": stride, "epsilon": epsilon, "momentum": momentum,
+               "prologue_act": prologue_act},
+    )
+    return RawConvBN(out, bmean, binv, scale, bias)
+
+
+def bn_stats(
+    input,
+    momentum: float = 0.9,
+    epsilon: float = 1e-5,
+    param_attr=None,
+    bias_attr=None,
+    name=None,
+) -> RawConvBN:
+    """Stats-only BatchNorm over a raw NHWC activation (one reduce pass);
+    pairs with bn_apply / a fused_conv_bn prologue for the normalize.
+    Parameter names match an unfused batch_norm at the same position."""
+    helper = LayerHelper("batch_norm", name=name)
+    c = input.shape[-1]
+    scale, bias, mean, var = _create_bn_params(helper, c, param_attr,
+                                               bias_attr)
+    bmean = helper.create_tmp_variable(np.float32, (c,))
+    binv = helper.create_tmp_variable(np.float32, (c,))
+    helper.append_op(
+        type="bn_stats",
+        inputs={"X": [input], "Mean": [mean], "Variance": [var]},
+        outputs={"BatchMean": [bmean], "BatchInv": [binv]},
+        attrs={"epsilon": epsilon, "momentum": momentum},
+    )
+    return RawConvBN(input, bmean, binv, scale, bias)
+
+
+def bn_apply(raw: RawConvBN, act: Optional[str] = None, name=None) -> Variable:
+    """Materialize the normalized activation of a RawConvBN (one XLA
+    elementwise pass, fused with adjacent adds/relus by the compiler)."""
+    helper = LayerHelper("bn_apply", name=name)
+    out = helper.create_tmp_variable(raw.out.dtype, raw.out.shape)
+    helper.append_op(
+        type="bn_apply",
+        inputs={"X": [raw.out], "Mean": [raw.mean], "Inv": [raw.inv],
+                "Scale": [raw.scale], "Bias": [raw.bias]},
+        outputs={"Out": [out]},
+        attrs={"act": act},
+    )
+    return out
 
 
 def layer_norm(input, scale=True, shift=True, begin_norm_axis=1, epsilon=1e-5, name=None):

@@ -18,6 +18,7 @@ from . import generation_ops  # noqa: F401
 from . import math_ops  # noqa: F401
 from . import nn_ops  # noqa: F401
 from . import flash_ops  # noqa: F401
+from . import fused_conv_ops  # noqa: F401
 from . import optimizer_ops  # noqa: F401
 from . import recurrent_ops  # noqa: F401
 from . import rnn_ops  # noqa: F401

@@ -88,6 +88,19 @@ def attention_gru_decoder_kernel(ctx):
         enc_b, wa_enc, preferred_element_type=jnp.float32
     ).astype(dt)  # [B, S, A]
 
+    from .bahdanau_kernels import (fused_attention_decoder,
+                                   fused_decoder_eligible)
+
+    B, S, A = enc_proj.shape
+    if fused_decoder_eligible(B, S, A, enc_b.shape[-1], enc_b.dtype):
+        # fused path: score+softmax+context in VMEM, whole-scan custom
+        # VJP (bahdanau_kernels.py) — never materializes [B, S, A]
+        h_seq = fused_attention_decoder(
+            enc_b, enc_proj, enc_mask, trg_b, trg_mask, h0,
+            wa_dec, v_att, wx, wh, bias)
+        ctx.set_output("Hidden", LoDArray.from_batch(h_seq, trg_mask, trg_l))
+        return
+
     def step(h_prev, inp):
         x_t, m_t = inp  # [B, E], [B]
         ctxv = _attention(h_prev, enc_b, enc_proj, enc_mask, wa_dec, v_att)

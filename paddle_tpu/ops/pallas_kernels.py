@@ -39,13 +39,19 @@ def _interpret() -> bool:
     return jax.default_backend() != "tpu"
 
 
-def _backend_ok() -> bool:
-    # interpret mode exists for tests; production dispatch must not send
-    # CPU/GPU users through the pure-Python interpreter when lax.scan is
-    # sitting right there (fused_rnn_interpret is the test override)
+def backend_ok(interpret_flag: str) -> bool:
+    """Shared dispatch gate for every fused-kernel family (RNN, conv,
+    attention): interpret mode exists for tests; production dispatch must
+    not send CPU/GPU users through the pure-Python interpreter when the
+    XLA formulation is sitting right there. `interpret_flag` names that
+    family's test-override flag."""
     from ..flags import FLAGS
 
-    return jax.default_backend() == "tpu" or FLAGS.fused_rnn_interpret
+    return jax.default_backend() == "tpu" or getattr(FLAGS, interpret_flag)
+
+
+def _backend_ok() -> bool:
+    return backend_ok("fused_rnn_interpret")
 
 
 # The backward kernel's VMEM working set must fit the 16M scoped budget;

@@ -447,6 +447,35 @@ def build_batch_norm():
 
 
 @case
+def build_fused_conv_bn():
+    # raw-stats fused conv protocol, no-prologue unit + normalize
+    x = L.data("x", shape=[4, 4, 6])
+    r = L.fused_conv_bn(x, num_filters=4)
+    return _scalar(L.bn_apply(r, act="relu")), _feed("x", (2, 4, 4, 6))
+
+
+@case
+def build_bn_stats():
+    # stats-only BN feeding a fused conv's prologue (the conv2->conv3
+    # seam of _bottleneck_fused)
+    x = L.data("x", shape=[4, 4, 3])
+    h = L.conv2d(x, num_filters=4, filter_size=3, padding=1,
+                 bias_attr=False, data_format="NHWC")
+    s = L.bn_stats(h)
+    r = L.fused_conv_bn(s, num_filters=4, prologue_act="relu")
+    return _scalar(L.bn_apply(r)), _feed("x", (2, 4, 4, 3))
+
+
+@case
+def build_bn_apply():
+    x = L.data("x", shape=[4, 4, 3])
+    h = L.conv2d(x, num_filters=4, filter_size=1, bias_attr=False,
+                 data_format="NHWC")
+    s = L.bn_stats(h)
+    return _scalar(L.bn_apply(s, act="relu")), _feed("x", (2, 4, 4, 3))
+
+
+@case
 def build_layer_norm():
     h, feed = _pre(3, 8)
     return _scalar(L.layer_norm(h)), feed
@@ -841,6 +870,8 @@ EXEMPT = {
     "detection_output": "decode-only: NMS box selection, integer/threshold logic",
     "BeamSearchDecoder": "decode-only generation driver (no training loss)",
     "attention_gru_beam_search": "decode-only generation driver",
+    "RawConvBN": "container type of the fused conv+BN protocol, not a "
+                 "layer fn (its three producers/consumers have cases)",
     "prior_box": "constant anchor generation from static shapes",
     "num_priors": "python-side shape helper returning an int",
     "dropout": "stochastic mask (identity at is_test); moments covered by the oracle tests",
