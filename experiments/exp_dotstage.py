@@ -53,13 +53,16 @@ def main():
 
     # (dot_max_n, pallas): 6272 = stage5 only; 25088 = stages 4+5;
     # 100352 = stages 3+4+5
+    from paddle_tpu.flags import FLAGS
+
     configs = [(0, "0"), (6272, "0"), (25088, "0"), (100352, "0"),
                (25088, "1"), (6272, "1")]
     variants = {}
     exe = pt.Executor(donate_state=True)
     for thr, pal in configs:
-        os.environ["PT_FUSED_CONV_DOT_MAX_N"] = str(thr)
-        os.environ["PT_FUSED_CONV_PALLAS"] = pal
+        # the op kernel reads these FLAGS at trace time (first run below)
+        FLAGS.fused_conv_dot_max_n = thr
+        FLAGS.fused_conv_pallas = pal == "1"
         prog, startup, loss = build()
         exe.run(startup)
         for _ in range(2):

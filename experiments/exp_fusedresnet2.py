@@ -1,10 +1,11 @@
 """Ablation: where does the fused conv+BN protocol's time go?
 
 Variants (same process, interleaved):
-  unfused        — baseline conv2d+batch_norm graph
-  jnp-protocol   — raw-stats protocol ops, Pallas disabled (XLA math):
-                   isolates the graph-restructure cost
-  pallas         — the full fused path
+  unfused   — baseline conv2d+batch_norm graph
+  proto4d   — raw-stats protocol, 4-D conv_general formulation (default)
+  proto2d   — protocol with every eligible 1x1 conv as a 2-D jnp dot
+              (fused_conv_dot_max_n=inf): isolates the relayout cost
+  pallas    — 2-D dispatch through the hand-written Pallas kernel
 Each timed fwd-only and full-train.
 
 Run on TPU: python experiments/exp_fusedresnet2.py
@@ -22,8 +23,10 @@ BATCH = int(os.environ.get("BATCH", 128))
 STEPS = int(os.environ.get("STEPS", 30))
 
 
-def build(fused, train, no_pallas):
+def build(fused, train, dot_max_n=0, pallas=False):
     FLAGS.use_fused_conv = fused
+    FLAGS.fused_conv_dot_max_n = dot_max_n
+    FLAGS.fused_conv_pallas = pallas
     prog, startup = pt.Program(), pt.Program()
     startup.random_seed = 7
     with pt.program_guard(prog, startup):
@@ -37,15 +40,12 @@ def build(fused, train, no_pallas):
             pt.optimizer.Momentum(learning_rate=0.1,
                                   momentum=0.9).minimize(loss)
     prog.set_amp("bfloat16")
-    return prog, startup, loss, no_pallas
+    return prog, startup, loss
 
 
 def main():
     import jax
 
-    from paddle_tpu.ops import fused_conv_ops as fco
-
-    real_eligible = fco.fused_conv_eligible
     rng = np.random.RandomState(0)
     feed = {
         "img": rng.randn(BATCH, 224, 224, 3).astype(np.float32),
@@ -55,27 +55,31 @@ def main():
     for v in feed.values():
         np.asarray(v.ravel()[0])
 
-    variants = {}
+    BIG = 1 << 30
+    configs = {}
     for train in (False, True):
         t = "train" if train else "fwd"
-        variants[f"unfused-{t}"] = build(False, train, False)
-        variants[f"jnpproto-{t}"] = build(True, train, True)
-        variants[f"pallas-{t}"] = build(True, train, False)
+        configs[f"unfused-{t}"] = (False, train, 0, False)
+        configs[f"proto4d-{t}"] = (True, train, 0, False)
+        configs[f"proto2d-{t}"] = (True, train, BIG, False)
+        configs[f"pallas-{t}"] = (True, train, BIG, True)
 
     exe = pt.Executor(donate_state=True)
-    for name, (prog, startup, loss, no_pallas) in variants.items():
-        fco.fused_conv_eligible = (
-            (lambda *a, **k: False) if no_pallas else real_eligible)
+    variants = {}
+    for name, cfg in configs.items():
+        # the op kernels read the dispatch FLAGS at TRACE time (the first
+        # exe.run), so each variant must build AND warm before the next
+        # variant's flags are set
+        prog, startup, loss = build(*cfg)
         exe.run(startup)
         for _ in range(2):
             (l,) = exe.run(prog, feed=feed, fetch_list=[loss])
         assert np.isfinite(l), f"{name}: loss {l}"
         print(f"compiled {name}: loss {float(l):.4f}", flush=True)
+        variants[name] = (prog, startup, loss)
 
     for rep in range(2):
-        for name, (prog, startup, loss, no_pallas) in variants.items():
-            fco.fused_conv_eligible = (
-                (lambda *a, **k: False) if no_pallas else real_eligible)
+        for name, (prog, startup, loss) in variants.items():
             t0 = time.perf_counter()
             for _ in range(STEPS):
                 (l,) = exe.run(prog, feed=feed, fetch_list=[loss],

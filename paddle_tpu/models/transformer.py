@@ -53,10 +53,17 @@ def transformer_lm(
     max_len: int = 1024,
     dropout_prob: float = 0.0,
     is_test: bool = False,
+    mp_axis: str = None,
     name: str = "tfm",
 ):
     """tokens: dense [B, T] int32 Variable (T <= max_len, static per
-    bucket). Returns per-position logits [B, T, vocab_size]."""
+    bucket). Returns per-position logits [B, T, vocab_size].
+
+    mp_axis: mesh-axis name for Megatron tensor parallelism — qkv and
+    ffn_in weights column-parallel, wo and ffn_out row-parallel, output
+    head vocab-sharded (Variable.sharding PartitionSpecs; GSPMD inserts
+    the per-block psum after the row-parallel matmuls). Run under a
+    ParallelExecutor whose mesh has that axis."""
     ffn_dim = ffn_dim or 4 * dim
     T = int(tokens.shape[1])
     if T > max_len:
@@ -77,6 +84,22 @@ def transformer_lm(
         x = _block(x, num_heads, ffn_dim, f"{name}.h{i}", dropout_prob,
                    is_test)
     x = layers.layer_norm(x, begin_norm_axis=2, name=f"{name}.ln_f")
-    return layers.fc(x, size=vocab_size, num_flatten_dims=2,
-                     param_attr=ParamAttr(name=f"{name}.out_w"),
-                     bias_attr=False)
+    out = layers.fc(x, size=vocab_size, num_flatten_dims=2,
+                    param_attr=ParamAttr(name=f"{name}.out_w"),
+                    bias_attr=False)
+    if mp_axis:
+        from jax.sharding import PartitionSpec
+
+        import paddle_tpu as pt
+
+        gb = pt.default_main_program().global_block()
+        col = PartitionSpec(None, mp_axis)   # split output features
+        row = PartitionSpec(mp_axis, None)   # split input features → psum
+        for i in range(num_layers):
+            p = f"{name}.h{i}"
+            for w, spec in ((f"{p}.attn.wq", col), (f"{p}.attn.wk", col),
+                            (f"{p}.attn.wv", col), (f"{p}.attn.wo", row),
+                            (f"{p}.ffn_in", col), (f"{p}.ffn_out", row)):
+                gb.var(w).sharding = spec
+        gb.var(f"{name}.out_w").sharding = col  # vocab-sharded head
+    return out

@@ -243,8 +243,21 @@ def _jnp_fused(x, w, pm, pi, ps, pb, prologue, prologue_relu):
     xn = _prologue(x, pm, pi, ps, pb, prologue, prologue_relu)
     acc = jnp.float32 if x.dtype == jnp.float32 else None
     y = jnp.dot(xn, w, preferred_element_type=acc).astype(x.dtype)
+    return (y,) + _sum_sq(y, axis=0)
+
+
+def _sum_sq(y, axis):
+    """Per-channel sum / sum-of-squares with f32 accumulation; the
+    bn_bf16_stats flag squares in the io dtype instead of upcasting
+    first (escape-route knob, PERF.md r4) — one definition for every
+    stats site."""
+    from ..flags import FLAGS
+
+    if FLAGS.bn_bf16_stats:
+        return (jnp.sum(y, axis=axis, dtype=jnp.float32),
+                jnp.sum(y * y, axis=axis, dtype=jnp.float32))
     yf = y.astype(jnp.float32)
-    return y, jnp.sum(yf, axis=0), jnp.sum(yf * yf, axis=0)
+    return jnp.sum(yf, axis=axis), jnp.sum(yf * yf, axis=axis)
 
 
 def _jnp_fused4(x4, w, pm, pi, ps, pb, prologue, prologue_relu):
@@ -259,13 +272,7 @@ def _jnp_fused4(x4, w, pm, pi, ps, pb, prologue, prologue_relu):
         dimension_numbers=("NHWC", "HWIO", "NHWC"),
         preferred_element_type=acc,
     ).astype(x4.dtype)
-    from ..flags import FLAGS
-
-    if FLAGS.bn_bf16_stats:
-        return (y, jnp.sum(y, axis=(0, 1, 2), dtype=jnp.float32),
-                jnp.sum(y * y, axis=(0, 1, 2), dtype=jnp.float32))
-    yf = y.astype(jnp.float32)
-    return y, jnp.sum(yf, axis=(0, 1, 2)), jnp.sum(yf * yf, axis=(0, 1, 2))
+    return (y,) + _sum_sq(y, axis=(0, 1, 2))
 
 
 def fused_matmul_bn(x, w, pm=None, pi=None, ps=None, pb=None,
@@ -359,12 +366,12 @@ def bn_stats_kernel(ctx):
     by the consumer (bn_apply or a fused_conv_bn prologue)."""
     x = ctx.input("X")
     eps = ctx.attr("epsilon", 1e-5)
-    xf = x.astype(jnp.float32)
-    bmean = jnp.mean(xf, axis=(0, 1, 2))
-    bvar = jnp.var(xf, axis=(0, 1, 2))
+    s, sq = _sum_sq(x, axis=(0, 1, 2))
+    n = float(x.size // x.shape[-1])
+    bmean, bvar, binv = _stats_to_mean_inv(s, sq, n, eps)
     _update_running(ctx, bmean, bvar)
     ctx.set_output("BatchMean", bmean)
-    ctx.set_output("BatchInv", jax.lax.rsqrt(bvar + eps))
+    ctx.set_output("BatchInv", binv)
 
 
 @register_op("bn_apply")
