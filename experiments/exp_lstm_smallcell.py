@@ -1,0 +1,164 @@
+"""Attribute the LSTM small-cell floor (reference grid h=256/bs=64:
+1.0% MFU, benchmarks/lstm_grid.json — VERDICT r3 weak #4).
+
+Decomposition ladders (fwd+bwd, chained, same process):
+  scan_floor  — trivial lax.scan, carry [B,H]: the per-step dispatch floor
+  matmul_only — scan of just the recurrent matmul h@W [B,H]x[H,4H]
+  cell        — full LSTM cell per step (x-proj precomputed, the
+                dynamic_lstm formulation)
+  cell_2layer — BOTH stacked layers inside ONE scan body (halves the
+                sequential step count vs two back-to-back layer scans)
+  fused       — the Pallas fused kernel at this shape (outside its
+                eligibility window; measured here to decide whether the
+                window should extend to small cells)
+Plus the in-framework bench number for the same cell as reference.
+
+Run on TPU: python experiments/exp_lstm_smallcell.py
+"""
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+B, H, T, E = 64, 256, 100, 128
+REPS = 20
+
+
+def timeit(f, *args):
+    r = f(*args)
+    np.asarray(jax.tree.leaves(r)[0].ravel()[0])
+    best = 1e9
+    for _ in range(3):
+        t0 = time.perf_counter()
+        r = f(*args)
+        np.asarray(jax.tree.leaves(r)[0].ravel()[0])
+        best = min(best, (time.perf_counter() - t0) / REPS)
+    return best
+
+
+def chain(step_fn, x0, xs):
+    """fwd+bwd through REPS chained scans; grads consumed with a real
+    (tiny) dependence so nothing is DCE'd."""
+
+    @jax.jit
+    def run(x0, xs):
+        def loss(x0, xs):
+            def body(c, x):
+                c = step_fn(c, x)
+                return c, c
+            c, ys = jax.lax.scan(body, x0, xs)
+            return jnp.sum(ys.astype(jnp.float32) * 1e-3)
+
+        def outer(carry, _):
+            x0, xs = carry
+            l, (dx0, dxs) = jax.value_and_grad(loss, argnums=(0, 1))(x0, xs)
+            eps = jnp.asarray(1e-12, x0.dtype)
+            return (x0 + eps * dx0, xs + eps * dxs), l
+
+        (_, _), ls = jax.lax.scan(outer, (x0, xs), None, length=REPS)
+        return ls[-1]
+
+    return run
+
+
+def main():
+    rng = np.random.RandomState(0)
+    dt = jnp.bfloat16
+    h0 = jnp.asarray(rng.randn(B, H) * 0.1, dt)
+    xp = jnp.asarray(rng.randn(T, B, 4 * H) * 0.1, dt)  # pre-projected
+    w = jnp.asarray(rng.randn(H, 4 * H) / np.sqrt(H), dt)
+    w2 = jnp.asarray(rng.randn(H, 4 * H) / np.sqrt(H), dt)
+    wx2 = jnp.asarray(rng.randn(H, 4 * H) / np.sqrt(H), dt)
+
+    def lstm_cell(hc, xp_t, w):
+        h, c = hc
+        g = xp_t + jnp.dot(h, w)
+        i, f, o, cand = jnp.split(g.astype(jnp.float32), 4, -1)
+        c = jax.nn.sigmoid(f) * c.astype(jnp.float32) \
+            + jax.nn.sigmoid(i) * jnp.tanh(cand)
+        h = jax.nn.sigmoid(o) * jnp.tanh(c)
+        return h.astype(xp_t.dtype), c.astype(xp_t.dtype)
+
+    results = {}
+    # trivial floor
+    results["scan_floor"] = timeit(
+        chain(lambda c, x: c + x * jnp.asarray(1e-6, dt), h0, xp[..., :H]),
+        h0, xp[..., :H])
+    # matmul only
+    results["matmul_only"] = timeit(
+        chain(lambda c, x: (x[..., :H]
+                            + jnp.dot(c, w)[..., :H]).astype(dt), h0,
+              xp), h0, xp)
+
+    # full cell (state packed in one array to keep chain() simple)
+    def cell_step(s, x):
+        h, c = s[..., :H], s[..., H:]
+        h, c = lstm_cell((h, c), x, w)
+        return jnp.concatenate([h, c], -1)
+
+    s0 = jnp.concatenate([h0, h0], -1)
+    results["cell"] = timeit(chain(cell_step, s0, xp), s0, xp)
+
+    # two stacked layers in ONE scan body
+    def cell2_step(s, x):
+        h1, c1, h2, c2 = (s[..., :H], s[..., H:2 * H],
+                          s[..., 2 * H:3 * H], s[..., 3 * H:])
+        h1, c1 = lstm_cell((h1, c1), x, w)
+        xp2 = jnp.dot(h1, wx2)
+        h2, c2 = lstm_cell((h2, c2), xp2, w2)
+        return jnp.concatenate([h1, c1, h2, c2], -1)
+
+    s20 = jnp.concatenate([h0] * 4, -1)
+    results["cell_2layer"] = timeit(chain(cell2_step, s20, xp), s20, xp)
+
+    for k, v in results.items():
+        toks = B * T / v
+        print(f"{k:12s}: {v*1e3:7.2f} ms/seq  per-step "
+              f"{v/T*1e6:6.1f} us  ({toks/1e3:7.0f}k tok-steps/s)",
+              flush=True)
+
+    # the Pallas fused kernel at this (out-of-window) shape, train config
+    from paddle_tpu.flags import FLAGS
+    from paddle_tpu.ops import pallas_kernels as pk
+
+    mask = jnp.ones((T, B), dt)
+
+    def fused_loss(xp_, h0_):
+        del h0_  # lstm_fused is zero-boot, matching the bench model
+        h_seq, _ = pk.lstm_fused(xp_, mask, w)
+        return jnp.sum(h_seq.astype(jnp.float32) * 1e-3)
+
+    def scan_loss(xp_, h0_):
+        z = jnp.zeros_like(h0_)
+        def body(sc, x):
+            h, c = sc
+            h, c = lstm_cell((h, c), x, w)
+            return (h, c), h
+        (_, _), h_seq = jax.lax.scan(body, (z, z), xp_)
+        return jnp.sum(h_seq.astype(jnp.float32) * 1e-3)
+
+    for name, lf in (("fused_kernel", fused_loss), ("scan_kernel",
+                                                    scan_loss)):
+        @jax.jit
+        def run(xp_, h0_, lf=lf):
+            def outer(carry, _):
+                xp_, h0_ = carry
+                l, (dxp, dh0) = jax.value_and_grad(lf, (0, 1))(xp_, h0_)
+                eps = jnp.asarray(1e-12, dt)
+                return (xp_ + eps * dxp, h0_ + eps * dh0), l
+            (_, _), ls = jax.lax.scan(outer, (xp_, h0_), None, length=REPS)
+            return ls[-1]
+
+        try:
+            t = timeit(run, xp, h0)
+            print(f"{name:12s}: {t*1e3:7.2f} ms/seq  per-step "
+                  f"{t/T*1e6:6.1f} us", flush=True)
+        except Exception as e:  # noqa: BLE001
+            print(f"{name}: failed ({str(e)[:120]})", flush=True)
+    del FLAGS
+
+
+if __name__ == "__main__":
+    main()

@@ -82,3 +82,12 @@ def train100():
 
 def test100():
     return _reader(100, False)
+
+
+def convert(path):
+    """Converts dataset to recordio shards (reference cifar.py:132)."""
+    from . import common
+    common.convert(path, train100(), 1000, "cifar_train100")
+    common.convert(path, test100(), 1000, "cifar_test100")
+    common.convert(path, train10(), 1000, "cifar_train10")
+    common.convert(path, test10(), 1000, "cifar_test10")

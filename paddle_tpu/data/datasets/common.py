@@ -17,7 +17,41 @@ from typing import Optional
 
 from . import data_home
 
-__all__ = ["md5file", "download"]
+__all__ = ["md5file", "download", "convert"]
+
+
+def convert(output_path, reader, line_count, name_prefix):
+    """Serialize a reader's samples to recordio shard files of up to
+    `line_count` records each, named `{output_path}/{name_prefix}-%05d`.
+
+    Reference: python/paddle/v2/dataset/common.py:200 `convert` — the
+    seam between the dataset zoo and the cloud data path (shards are the
+    task unit the master dispatches, go/master/service.go; here
+    native/master.cc + data/recordio.py master_reader). `reader` may be
+    a reader function or an already-created sample iterable, as in the
+    reference's per-dataset convert() callers.
+    """
+    import itertools
+
+    from ..recordio import write_shard
+
+    assert line_count >= 1
+    # accept a reader fn, a reader-creator, or a sample iterable (the
+    # reference's per-dataset callers pass all three styles)
+    samples = reader
+    while callable(samples):
+        samples = samples()
+    samples = iter(samples)
+    os.makedirs(output_path, exist_ok=True)
+    paths = []
+    for idx in itertools.count():
+        chunk = list(itertools.islice(samples, line_count))
+        if not chunk:
+            break
+        path = os.path.join(output_path, f"{name_prefix}-{idx:05d}")
+        write_shard(path, chunk)
+        paths.append(path)
+    return paths
 
 
 def md5file(fname: str) -> str:

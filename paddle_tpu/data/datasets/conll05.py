@@ -105,3 +105,14 @@ def train():
 
 def test():
     return _reader(_N_TEST, 22)
+
+
+def convert(path):
+    """Converts dataset to recordio shards. The reference wrote test()
+    into both prefixes because its train split was license-gated
+    (conll05.py convert); here train() exists, so the train shards carry
+    the actual train split."""
+    from . import common
+
+    common.convert(path, train(), 1000, "conll05_train")
+    common.convert(path, test(), 1000, "conll05_test")

@@ -120,3 +120,12 @@ def test(word_idx=None):
             yield ids, label
 
     return reader
+
+
+def convert(path):
+    """Converts dataset to recordio shards (reference imdb.py convert)."""
+    from . import common
+
+    w = word_dict()
+    common.convert(path, lambda: train(w), 1000, "imdb_train")
+    common.convert(path, lambda: test(w), 1000, "imdb_test")

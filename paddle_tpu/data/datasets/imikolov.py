@@ -108,3 +108,13 @@ def train(word_idx, n, data_type=DataType.NGRAM):
 
 def test(word_idx, n, data_type=DataType.NGRAM):
     return _reader(word_idx, n, data_type, False)
+
+
+def convert(path):
+    """Converts dataset to recordio shards (reference imikolov.py convert)."""
+    from . import common
+
+    n = 5
+    word_dict = build_dict()
+    common.convert(path, train(word_dict, n), 1000, "imikolov_train")
+    common.convert(path, test(word_dict, n), 1000, "imikolov_test")

@@ -81,3 +81,10 @@ def test():
             yield images[i], int(labels[i])
 
     return reader
+
+
+def convert(path):
+    """Converts dataset to recordio shards (reference mnist.py convert)."""
+    from . import common
+    common.convert(path, train(), 1000, "mnist_train")
+    common.convert(path, test(), 1000, "mnist_test")

@@ -242,3 +242,11 @@ def test():
     if z:
         return _real_reader(z, is_test=True)
     return _reader(_N_TEST, 12)
+
+
+def convert(path):
+    """Converts dataset to recordio shards (reference movielens.py convert)."""
+    from . import common
+
+    common.convert(path, train(), 1000, "movielens_train")
+    common.convert(path, test(), 1000, "movielens_test")

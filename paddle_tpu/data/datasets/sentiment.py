@@ -43,3 +43,10 @@ def train():
 
 def test():
     return _reader(_N_TEST, 52)
+
+
+def convert(path):
+    """Converts dataset to recordio shards (reference sentiment.py convert)."""
+    from . import common
+    common.convert(path, train, 1000, "sentiment_train")
+    common.convert(path, test, 1000, "sentiment_test")

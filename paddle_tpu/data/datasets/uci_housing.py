@@ -84,3 +84,11 @@ def train():
 
 def test():
     return _reader(False)
+
+
+def convert(path):
+    """Converts dataset to recordio shards (reference uci_housing.py:129)."""
+    from . import common
+
+    common.convert(path, train(), 1000, "uci_housing_train")
+    common.convert(path, test(), 1000, "uci_housing_test")

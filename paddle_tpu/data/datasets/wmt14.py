@@ -142,3 +142,12 @@ def get_dict(dict_size: int, reverse: bool = False):
         return {v: k for k, v in d.items()} if reverse else d
 
     return mk(), mk()
+
+
+def convert(path):
+    """Converts dataset to recordio shards (reference wmt14.py convert)."""
+    from . import common
+
+    dict_size = 30000
+    common.convert(path, train(dict_size), 1000, "wmt14_train")
+    common.convert(path, test(dict_size), 1000, "wmt14_test")

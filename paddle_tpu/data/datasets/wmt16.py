@@ -23,3 +23,16 @@ def test(src_dict_size: int, trg_dict_size: int, src_lang: str = "en"):
 def get_dict(lang: str, dict_size: int, reverse: bool = False):
     d, _ = wmt14.get_dict(dict_size, reverse)
     return d
+
+
+def convert(path, src_dict_size, trg_dict_size, src_lang="en"):
+    """Converts dataset to recordio shards (reference wmt16.py convert)."""
+    from . import common
+    common.convert(
+        path, train(src_dict_size=src_dict_size,
+                    trg_dict_size=trg_dict_size, src_lang=src_lang),
+        1000, "wmt16_train")
+    common.convert(
+        path, test(src_dict_size=src_dict_size,
+                   trg_dict_size=trg_dict_size, src_lang=src_lang),
+        1000, "wmt16_test")

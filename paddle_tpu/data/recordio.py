@@ -15,7 +15,28 @@ from typing import Callable, List, Optional, Sequence
 
 from ..native import Master, Prefetcher, RecordIOReader, RecordIOWriter
 
-__all__ = ["dump_reader", "recordio_reader", "master_reader"]
+__all__ = ["dump_reader", "recordio_reader", "master_reader",
+           "write_shard"]
+
+
+def _dumps(sample) -> bytes:
+    """The one record serialization (shared by every shard writer)."""
+    return pickle.dumps(sample, pickle.HIGHEST_PROTOCOL)
+
+
+def write_shard(path: str, samples) -> int:
+    """Write an iterable of samples as one recordio shard; returns the
+    record count. The sequential-chunk sharding of the dataset zoo's
+    convert() (data/datasets/common.py) builds on this."""
+    w = RecordIOWriter(path)
+    n = 0
+    try:
+        for s in samples:
+            w.write(_dumps(s))
+            n += 1
+    finally:
+        w.close()
+    return n
 
 
 def dump_reader(reader: Callable, path_prefix: str, num_shards: int = 1,
@@ -33,9 +54,7 @@ def dump_reader(reader: Callable, path_prefix: str, num_shards: int = 1,
                 i // num_shards
             ) >= max_records_per_shard:
                 break
-            writers[i % num_shards].write(
-                pickle.dumps(sample, pickle.HIGHEST_PROTOCOL)
-            )
+            writers[i % num_shards].write(_dumps(sample))
     finally:
         for w in writers:
             w.close()
