@@ -120,7 +120,6 @@ def main():
               flush=True)
 
     # the Pallas fused kernel at this (out-of-window) shape, train config
-    from paddle_tpu.flags import FLAGS
     from paddle_tpu.ops import pallas_kernels as pk
 
     mask = jnp.ones((T, B), dt)
@@ -157,7 +156,6 @@ def main():
                   f"{t/T*1e6:6.1f} us", flush=True)
         except Exception as e:  # noqa: BLE001
             print(f"{name}: failed ({str(e)[:120]})", flush=True)
-    del FLAGS
 
 
 if __name__ == "__main__":

@@ -167,6 +167,16 @@ define_flag("use_fused_attention", True,
 define_flag("fused_attention_interpret", False,
             "testing only: allow the fused attention decoder kernels in "
             "pallas interpret mode on non-TPU backends")
+define_flag("fused_attention_seq_fwd", False,
+            "run the fused decoder's FORWARD as one whole-sequence pallas "
+            "kernel (grid (T, batch-tiles), hidden state in VMEM scratch "
+            "— the fused-LSTM pattern extended with the attention "
+            "prologue) instead of a per-step kernel inside lax.scan. "
+            "Off by default: measured exactly neutral at the NMT config "
+            "(256.1 vs 256.2k tok/s bs256 — the scan's per-step cost is "
+            "device-side loop overhead that the kernel's T x batch-tile "
+            "grid floor matches); kept tested for parts where dispatch "
+            "economics differ")
 define_flag("bn_bf16_stats", True,
             "batch_norm stats: square in the io dtype with f32 reduction "
             "accumulation instead of upcasting the activation first. "

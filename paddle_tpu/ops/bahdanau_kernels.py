@@ -264,6 +264,113 @@ def _attn_phase2(ep, dp_seq, dsc_seq, v, C, interpret):
     return dep, dv[0]
 
 
+# ------------------------------------------- whole-sequence forward kernel --
+def _decoder_seq_kernel(ep_ref, enc_ref, mask_ref, xpx_ref, tmask_ref,
+                        h0_ref, wadec_ref, v_ref, wxc_ref, wur_ref, wc_ref,
+                        h_ref, alpha_ref, ctx_ref, h_s):
+    """One grid step = (timestep t, batch tile b): Bahdanau attention +
+    GRU cell entirely in VMEM, hidden state carried in scratch across t
+    (the fused-LSTM whole-sequence pattern, pallas_kernels.py, extended
+    with the attention prologue). xpx is the hoisted input half of the
+    gate projection (trg @ wx[:E] + bias — no sequential dependency)."""
+    t = pl.program_id(0)
+    b = pl.program_id(1)
+    H = h0_ref.shape[-1]
+    blk = h0_ref.shape[0]
+    rows = pl.ds(b * blk, blk)  # this tile's rows of the [B, H] scratch
+
+    @pl.when(t == 0)
+    def _():
+        h_s[rows, :] = h0_ref[:]
+
+    h = h_s[rows, :]                              # [blk, H]
+    dp = jnp.dot(h, wadec_ref[:],
+                 preferred_element_type=jnp.float32)      # [blk, A]
+    th = jnp.tanh(ep_ref[:].astype(jnp.float32) + dp[:, None, :])
+    scores = jnp.sum(th * v_ref[0].astype(jnp.float32)[None, None, :], -1)
+    scores = jnp.where(mask_ref[:] > 0, scores, -1e9)
+    m = jnp.max(scores, -1, keepdims=True)
+    e = jnp.exp(scores - m)
+    alpha = e / jnp.sum(e, -1, keepdims=True)
+    alpha_ref[:] = alpha[None]
+    enc = enc_ref[:]
+    ctx = jax.lax.dot_general(
+        alpha.astype(enc.dtype)[:, None, :], enc,
+        (((2,), (1,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32,
+    )[:, 0, :]                                     # [blk, C] f32
+    ctx_c = ctx.astype(enc.dtype)
+    ctx_ref[:] = ctx_c[None]
+    # gate pre-activations: hoisted x-half + ctx-half
+    xp = xpx_ref[:][0] + jnp.dot(ctx_c, wxc_ref[:]).astype(h.dtype)
+    ur = jax.nn.sigmoid(
+        xp[..., : 2 * H]
+        + jnp.dot(h, wur_ref[:]).astype(h.dtype))
+    u, r = ur[..., :H], ur[..., H:]
+    c = jnp.tanh(
+        xp[..., 2 * H:]
+        + jnp.dot(r * h, wc_ref[:]).astype(h.dtype))
+    h_new = (1 - u) * h + u * c
+    tm = tmask_ref[:][0][:, None].astype(h.dtype)  # [blk, 1]
+    h_out = tm * h_new + (1 - tm) * h
+    h_s[rows, :] = h_out
+    h_ref[:] = h_out[None]
+
+
+def _decoder_seq_fwd(ep, enc, maskf, xpx, tmask, h0, wa_dec, v, wx_c,
+                     w_ur, w_c, interpret):
+    B, Sp, A = ep.shape
+    C = enc.shape[-1]
+    T = xpx.shape[0]
+    H = h0.shape[-1]
+    G3 = xpx.shape[-1]
+    blk = _bblk(B, Sp, A, C, ep.dtype.itemsize)
+    nb = B // blk
+    h_seq, alpha_seq, ctx_seq = pl.pallas_call(
+        _decoder_seq_kernel,
+        grid=(T, nb),
+        in_specs=[
+            pl.BlockSpec((blk, Sp, A), lambda t, b: (b, 0, 0)),
+            pl.BlockSpec((blk, Sp, C), lambda t, b: (b, 0, 0)),
+            pl.BlockSpec((blk, Sp), lambda t, b: (b, 0)),
+            pl.BlockSpec((1, blk, G3), lambda t, b: (t, b, 0)),
+            pl.BlockSpec((1, blk), lambda t, b: (t, b)),
+            pl.BlockSpec((blk, H), lambda t, b: (b, 0)),
+            pl.BlockSpec((H, A), lambda t, b: (0, 0)),
+            pl.BlockSpec((1, A), lambda t, b: (0, 0)),
+            pl.BlockSpec((C, G3), lambda t, b: (0, 0)),
+            pl.BlockSpec((H, 2 * H), lambda t, b: (0, 0)),
+            pl.BlockSpec((H, H), lambda t, b: (0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, blk, H), lambda t, b: (t, b, 0)),
+            pl.BlockSpec((1, blk, Sp), lambda t, b: (t, b, 0)),
+            pl.BlockSpec((1, blk, C), lambda t, b: (t, b, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((T, B, H), h0.dtype),
+            jax.ShapeDtypeStruct((T, B, Sp), jnp.float32),
+            jax.ShapeDtypeStruct((T, B, C), enc.dtype),
+        ],
+        scratch_shapes=[pltpu.VMEM((B, H), h0.dtype)],
+        interpret=interpret,
+    )(ep, enc, maskf, xpx, tmask, h0, wa_dec, v.reshape(1, -1), wx_c,
+      w_ur, w_c)
+    return h_seq, alpha_seq, ctx_seq
+
+
+def _mega_vmem_ok(B, Sp, A, C, E, H, itemsize) -> bool:
+    """Whole-sequence kernel working set: resident weights + streamed
+    ep/enc tiles + f32 tanh temporaries."""
+    blk = _bblk(B, Sp, A, C, itemsize)
+    if blk == 0:
+        return False
+    weights = (H * A + C * 3 * H + H * 3 * H + A) * itemsize
+    streams = 2 * blk * (Sp * (A + C) + 3 * H + E) * itemsize
+    temps = 3 * blk * Sp * A * 4
+    return weights + streams + temps <= _VMEM_BUDGET
+
+
 # -------------------------------------------------- the decoder, custom VJP --
 def _gru_fwd_step(xp, h_prev, wh, H):
     w_ur, w_c = wh[:, : 2 * H], wh[:, 2 * H:]
@@ -286,7 +393,22 @@ def _decoder_fn(interpret: bool):
     """
 
     def forward(enc, ep, maskf, trg, tmask, h0, wa_dec, v, wx, wh, bias):
+        from ..flags import FLAGS
+
         H = h0.shape[-1]
+        E = trg.shape[-1]
+        B, Sp, A = ep.shape
+        if FLAGS.fused_attention_seq_fwd and _mega_vmem_ok(
+                B, Sp, A, enc.shape[-1], E, H, ep.dtype.itemsize):
+            # whole-sequence kernel: every per-step dispatch collapses
+            # into one pallas_call; the x-half of the gate projection
+            # has no sequential dependency and hoists to one batched
+            # matmul
+            xpx = (jnp.dot(trg, wx[:E]).astype(trg.dtype) + bias)
+            return _decoder_seq_fwd(
+                ep, enc, maskf, xpx, tmask.astype(jnp.float32), h0,
+                wa_dec, v, wx[E:], wh[:, : 2 * H], wh[:, 2 * H:],
+                interpret)
 
         def step(h_prev, inp):
             x_t, m_t = inp

@@ -83,12 +83,20 @@ def test_eligibility_gates():
             FLAGS.fused_attention_interpret = False
 
 
-def test_fused_decoder_forward_parity(interpret_flag):
-    args = _make_inputs()
-    ref = _scan_decoder(*args)
-    got = fused_attention_decoder(*args)
-    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
-                               rtol=2e-5, atol=2e-5)
+@pytest.mark.parametrize("seq_fwd", [True, False])
+def test_fused_decoder_forward_parity(interpret_flag, seq_fwd):
+    """Both forward formulations — the per-step kernel inside lax.scan
+    (default) and the whole-sequence kernel — match the XLA scan."""
+    prev = FLAGS.fused_attention_seq_fwd
+    FLAGS.fused_attention_seq_fwd = seq_fwd
+    try:
+        args = _make_inputs()
+        ref = _scan_decoder(*args)
+        got = fused_attention_decoder(*args)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)
+    finally:
+        FLAGS.fused_attention_seq_fwd = prev
 
 
 def test_fused_decoder_gradient_parity(interpret_flag):

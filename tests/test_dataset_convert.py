@@ -11,11 +11,12 @@ import os
 import numpy as np
 import pytest
 
-from paddle_tpu.data.datasets import common, uci_housing
-from paddle_tpu.data.recordio import master_reader, recordio_reader
-
 pytest.importorskip("paddle_tpu.native",
                     reason="native library build unavailable")
+
+from paddle_tpu.data.datasets import common, uci_housing  # noqa: E402
+from paddle_tpu.data.recordio import (master_reader,  # noqa: E402
+                                      recordio_reader)
 
 
 def test_convert_shards_and_roundtrip(tmp_path):
