@@ -280,6 +280,11 @@ _ALL_MODELS = [
     # with batch where the scan regressed (256k vs 218k tok/s at bs256 —
     # experiments/exp_fusedattn.py)
     ("nmt", {"BENCH_STEPS": "100", "BENCH_BATCH": "256"}),
+    # the deployment-path inference number rides along in the driver
+    # record (key "resnet_infer"); reference table
+    # IntelOptimizedPaddle.md:80-86
+    ("resnet_infer", {"BENCH_MODEL": "resnet", "BENCH_INFER": "1",
+                      "BENCH_STEPS": "60"}),
     ("transformer", {"BENCH_HIDDEN": "2048", "BENCH_DEPTH": "8",
                      "BENCH_BATCH": "8", "BENCH_REMAT": "full"}),
 ]
@@ -303,7 +308,7 @@ def run_all():
                      "BENCH_HIDDEN", "BENCH_DEPTH", "BENCH_REMAT",
                      "BENCH_BATCH"):
             env.pop(flag, None)
-        env["BENCH_MODEL"] = model
+        env["BENCH_MODEL"] = model  # rows may override via extra_env
         env.update(extra_env)
         try:
             out = subprocess.run(

@@ -57,19 +57,10 @@ def multi_head_attention(
     if E % num_heads:
         raise ValueError(f"hidden dim {E} not divisible by {num_heads} heads")
 
-    import dataclasses
-
     def _derive(attr, s):
-        """Per-projection attr: keep every field of a caller-supplied
-        ParamAttr but derive a distinct name — passing it through
-        unchanged would tie wq/wk/wv/wo into ONE shared parameter."""
-        if attr is None:
-            return ParamAttr(name=f"{helper.name}.{s}")
-        if attr is False:
-            return False
-        attr = ParamAttr.to_attr(attr)
-        base = attr.name or helper.name
-        return dataclasses.replace(attr, name=f"{base}.{s}")
+        # distinct per-projection names; ParamAttr.derive prevents
+        # wq/wk/wv/wo collapsing into ONE shared parameter
+        return ParamAttr.derive(attr, helper.name, s)
 
     proj = lambda x, s: fc(x, size=E, num_flatten_dims=2,
                            param_attr=_derive(param_attr, s),

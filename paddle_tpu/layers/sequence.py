@@ -17,6 +17,7 @@ from .helper import LayerHelper
 
 __all__ = [
     "dynamic_lstm",
+    "stacked_lstm2",
     "dynamic_gru",
     "simple_rnn",
     "sequence_pool",
@@ -80,6 +81,57 @@ def dynamic_lstm(
             "candidate_activation": candidate_activation,
             "max_len": max_len,
         },
+    )
+    return out
+
+
+def stacked_lstm2(
+    input,
+    size: int,
+    param_attr=None,
+    bias_attr=None,
+    max_len: Optional[int] = None,
+    name=None,
+):
+    """Two stacked LSTM layers with the inter-layer [H, 4H] projection
+    absorbed into one op — the hot structure of the reference's headline
+    RNN benchmark (benchmark/paddle/rnn/rnn.py: 2× stacked LSTM).
+    `size` is 4*hidden; `input` is the layer-1 [*, 4H] projection.
+    Dispatch (trace time): per-layer fused Pallas kernels where
+    eligible, else a single scan carrying both layers' state (halves
+    the sequential step count — the measured small-cell lever, PERF.md
+    r4).
+
+    `max_len` bounds the scan length and MUST be >= the longest
+    sequence in any batch: timesteps beyond max_len are silently
+    dropped (their hidden states stay zero), exactly as dynamic_lstm.
+    Default: the LoDArray capacity, which is always safe but scans
+    padding."""
+    from ..param_attr import ParamAttr
+
+    helper = LayerHelper("stacked_lstm2", name=name)
+    hidden = size // 4
+    xav = XavierInitializer()
+    mk = lambda suffix, shape: helper.create_parameter(  # noqa: E731
+        ParamAttr.derive(param_attr, helper.name, suffix), shape,
+        default_initializer=xav)
+    w1 = mk("w1", (hidden, 4 * hidden))
+    wx2 = mk("wx2", (hidden, 4 * hidden))
+    w2 = mk("w2", (hidden, 4 * hidden))
+    inputs = {"Input": [input], "Weight1": [w1], "WX2": [wx2],
+              "Weight2": [w2]}
+    if bias_attr is not False:
+        mkb = lambda suffix: helper.create_parameter(  # noqa: E731
+            ParamAttr.derive(bias_attr, helper.name, suffix),
+            (4 * hidden,), is_bias=True)
+        inputs["Bias1"] = [mkb("b1")]
+        inputs["Bias2"] = [mkb("b2")]
+    out = helper.create_tmp_variable(input.dtype, (-1, hidden), lod_level=1)
+    helper.append_op(
+        type="stacked_lstm2",
+        inputs=inputs,
+        outputs={"Hidden": [out]},
+        attrs={"max_len": max_len},
     )
     return out
 

@@ -29,9 +29,11 @@ def lstm_benchmark_net(words, vocab_size, emb_dim=128, hidden=512,
     else:
         emb = layers.embedding(words, size=[vocab_size, emb_dim])
     proj1 = layers.fc(emb, size=hidden * 4, bias_attr=False)
-    lstm1 = layers.dynamic_lstm(proj1, size=hidden * 4, max_len=max_len)
-    proj2 = layers.fc(lstm1, size=hidden * 4, bias_attr=False)
-    lstm2 = layers.dynamic_lstm(proj2, size=hidden * 4, max_len=max_len)
+    # both stacked layers + the inter-layer projection in one op: the
+    # op dispatches per-layer fused kernels where eligible, else a
+    # single both-layers scan (the small-cell dispatch-floor lever —
+    # PERF.md r4)
+    lstm2 = layers.stacked_lstm2(proj1, size=hidden * 4, max_len=max_len)
     pooled = layers.sequence_pool(lstm2, "last")
     return layers.fc(pooled, size=class_dim)
 

@@ -78,6 +78,24 @@ class ParamAttr:
     update_hooks: Optional[List[Any]] = None
 
     @staticmethod
+    def derive(attr, base_default: str, suffix: str):
+        """Per-weight attr for multi-parameter layers (MHA projections,
+        stacked_lstm2 weights): keep every field of a caller-supplied
+        attr but derive a distinct `{base}.{suffix}` name — passing the
+        attr through unchanged would tie the weights into ONE shared
+        parameter. attr=None derives from `base_default`; attr=False
+        passes through (explicit "no parameter")."""
+        import dataclasses
+
+        if attr is None:
+            return ParamAttr(name=f"{base_default}.{suffix}")
+        if attr is False:
+            return False
+        attr = ParamAttr.to_attr(attr)
+        base = attr.name or base_default
+        return dataclasses.replace(attr, name=f"{base}.{suffix}")
+
+    @staticmethod
     def to_attr(arg) -> "ParamAttr":
         if arg is None:
             return ParamAttr()

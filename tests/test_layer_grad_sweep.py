@@ -447,6 +447,13 @@ def build_batch_norm():
 
 
 @case
+def build_stacked_lstm2():
+    emb, feed = _pre_seq(lens=(4, 2), d=8)
+    h = L.stacked_lstm2(emb, size=8, max_len=8)
+    return _scalar(L.sequence_last_step(h)), feed
+
+
+@case
 def build_fused_conv_bn():
     # raw-stats fused conv protocol, no-prologue unit + normalize
     x = L.data("x", shape=[4, 4, 6])

@@ -1,0 +1,95 @@
+"""stacked_lstm2: both stacked layers + inter-layer projection in one op.
+
+Reference structure: benchmark/paddle/rnn/rnn.py (2x stacked LSTM) —
+the hot config of the reference's headline RNN benchmark. The single
+both-layers scan must match the two-dynamic_lstm formulation exactly
+when fed the same weights.
+"""
+
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu.core.lod import LoDArray
+
+
+def _feed(B=4, Tmax=10, F=12, seed=0):
+    rng = np.random.RandomState(seed)
+    seqs = [rng.randn(rng.randint(4, Tmax), F).astype(np.float32) * 0.3
+            for _ in range(B)]
+    return {"x": LoDArray.from_sequences(seqs, capacity=B * Tmax,
+                                         max_seqs=B),
+            "y": rng.randn(B, 1).astype(np.float32)}
+
+
+def _build(stacked, H=8, F=12):
+    pt.reset()
+    pt.default_startup_program().random_seed = 3
+    x = pt.layers.data("x", shape=[F], lod_level=1)
+    y = pt.layers.data("y", shape=[1])
+    proj1 = pt.layers.fc(x, size=4 * H, bias_attr=False,
+                         param_attr=pt.ParamAttr(name="proj1"))
+    if stacked:
+        h2 = pt.layers.stacked_lstm2(proj1, size=4 * H,
+                                     param_attr=pt.ParamAttr(name="s"),
+                                     bias_attr=pt.ParamAttr(name="sb"))
+    else:
+        h1 = pt.layers.dynamic_lstm(proj1, size=4 * H,
+                                    param_attr=pt.ParamAttr(name="s.w1"),
+                                    bias_attr=pt.ParamAttr(name="sb.b1"))
+        p2 = pt.layers.fc(h1, size=4 * H, bias_attr=False,
+                          param_attr=pt.ParamAttr(name="s.wx2"))
+        h2 = pt.layers.dynamic_lstm(p2, size=4 * H,
+                                    param_attr=pt.ParamAttr(name="s.w2"),
+                                    bias_attr=pt.ParamAttr(name="sb.b2"))
+    pooled = pt.layers.sequence_pool(h2, "last")
+    pred = pt.layers.fc(pooled, size=1, param_attr=pt.ParamAttr(name="out"))
+    loss = pt.layers.mean(pt.layers.square_error_cost(pred, y))
+    pt.optimizer.Adam(learning_rate=0.01).minimize(loss)
+    return loss
+
+
+def test_stacked_matches_two_layer_formulation():
+    """Same weight names -> identical init -> identical losses over a
+    few Adam steps between the fused op and the two-op formulation."""
+    feed = _feed()
+    results = {}
+    for stacked in (False, True):
+        loss = _build(stacked)
+        exe = pt.Executor()
+        exe.run(pt.default_startup_program())
+        ls = []
+        for _ in range(4):
+            (l,) = exe.run(feed=feed, fetch_list=[loss])
+            ls.append(float(l))
+        results[stacked] = ls
+    np.testing.assert_allclose(results[True], results[False],
+                               rtol=1e-5, atol=1e-6)
+    assert results[True][-1] < results[True][0]
+
+
+def test_stacked_lstm_in_benchmark_net():
+    """lstm_benchmark_net routes through the stacked op and trains."""
+    pt.reset()
+    from paddle_tpu import models
+
+    words = pt.layers.data("words", shape=[-1], dtype=np.int32,
+                           lod_level=1, append_batch_size=False)
+    label = pt.layers.data("label", shape=[1], dtype=np.int32)
+    logits = models.lstm_benchmark_net(words, vocab_size=50, emb_dim=8,
+                                       hidden=8, max_len=8)
+    loss = pt.layers.mean(
+        pt.layers.softmax_with_cross_entropy(logits, label))
+    pt.optimizer.Adam(learning_rate=0.01).minimize(loss)
+    ops = [op.type for op in pt.default_main_program().global_block().ops]
+    assert "stacked_lstm2" in ops
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    rng = np.random.RandomState(0)
+    seqs = [rng.randint(0, 50, (6,)).astype(np.int32) for _ in range(4)]
+    feed = {"words": LoDArray.from_sequences(seqs, capacity=32, max_seqs=4),
+            "label": rng.randint(0, 2, (4, 1)).astype(np.int32)}
+    ls = []
+    for _ in range(10):
+        (l,) = exe.run(feed=feed, fetch_list=[loss])
+        ls.append(float(l))
+    assert np.isfinite(ls).all() and ls[-1] < ls[0]
