@@ -47,22 +47,28 @@ def _run(produce_sleep, step_sleep, n_batches):
 
 def test_overlap_hides_faster_producer():
     """Producer faster than the step → pipelined time ~= consumer time
-    alone (>=90% overlap efficiency), sequential pays the sum."""
+    alone (>=90% overlap efficiency), sequential pays the sum.
+
+    This is a wall-clock measurement on a single-core box: transient
+    contention (another suite, a bench subprocess) starves the producer
+    thread and tanks one reading (observed 0.59-0.85 under load,
+    >=0.95 in isolation), so the measurement retries before failing
+    rather than loosening the bar."""
     n = 8
     produce, step = 0.02, 0.06
-    t_pipe, t_seq = _run(produce, step, n)
-    # h2d put of the 77MB batch costs some real time on CPU too; bound
-    # the consumer-side ideal by the measured sequential minus produce
-    per_pipe = t_pipe / n
-    per_seq = t_seq / n
-    eff = (per_seq - produce) / per_pipe
-    # 0.75: the producer thread starves when the suite shares this
-    # box's single core with other work (observed 0.80-0.85 under
-    # contention, >=0.95 in isolation) — the second assert still pins
-    # the overlap's absolute saving
-    assert eff >= 0.75, (per_pipe, per_seq, eff)
-    # and the overlap actually saved ~the produce time per batch
-    assert per_pipe < per_seq - 0.5 * produce, (per_pipe, per_seq)
+    attempts = []
+    for _ in range(3):
+        t_pipe, t_seq = _run(produce, step, n)
+        # h2d put of the 77MB batch costs some real time on CPU too;
+        # bound the consumer-side ideal by sequential minus produce
+        per_pipe = t_pipe / n
+        per_seq = t_seq / n
+        eff = (per_seq - produce) / per_pipe
+        attempts.append((eff, per_pipe, per_seq))
+        if eff >= 0.9 and per_pipe < per_seq - 0.5 * produce:
+            return
+    raise AssertionError(f"overlap efficiency below 0.9 in 3 attempts: "
+                         f"{attempts}")
 
 
 def test_producer_bound_degrades_gracefully():
