@@ -1,0 +1,100 @@
+"""In-framework A/B: whole-sequence fused decoder BACKWARD kernel vs the
+reverse-scan-of-per-step-kernels backward (both with the fused forward).
+
+Same-process interleaved (PERF.md methodology), bs 128 and 256.
+FLAGS.fused_attention_seq_bwd is read at trace time, so each variant's
+program must be warmed (= traced) while the flag holds its value.
+Run on TPU: python experiments/exp_megabwd.py
+"""
+import os
+import time
+
+import numpy as np
+
+STEPS = int(os.environ.get("STEPS", 60))
+SEQLEN = 50
+
+
+def build(batch):
+    import paddle_tpu as pt
+    from paddle_tpu import models
+    from paddle_tpu.core.lod import LoDArray
+
+    vocab, hidden = 30000, 512
+    prog, startup = pt.Program(), pt.Program()
+    startup.random_seed = 7
+    with pt.program_guard(prog, startup):
+        src = pt.layers.data("src", shape=[-1], dtype=np.int32, lod_level=1,
+                             append_batch_size=False)
+        trg_in = pt.layers.data("trg_in", shape=[-1], dtype=np.int32,
+                                lod_level=1, append_batch_size=False)
+        label = pt.layers.data("label", shape=[-1], dtype=np.int32,
+                               lod_level=1, append_batch_size=False)
+        logits = models.seq2seq_attention(
+            src, trg_in, src_vocab=vocab, trg_vocab=vocab,
+            emb_dim=hidden, enc_hidden=hidden, dec_hidden=hidden,
+            src_max_len=SEQLEN, trg_max_len=SEQLEN)
+        tok_loss = pt.layers.softmax_with_cross_entropy(logits, label)
+        loss = pt.layers.mean(pt.layers.sequence_pool(tok_loss, "sum"))
+        pt.optimizer.Adam(learning_rate=5e-4).minimize(loss)
+    prog.set_amp("bfloat16")
+    rng = np.random.RandomState(0)
+    pack = lambda seqs: LoDArray.from_sequences(  # noqa: E731
+        seqs, capacity=batch * SEQLEN, max_seqs=batch)
+    seqs = [rng.randint(2, vocab, (SEQLEN,)).astype(np.int32)
+            for _ in range(batch)]
+    feed = {"src": pack(seqs), "trg_in": pack(seqs), "label": pack(seqs)}
+    return prog, startup, loss, feed
+
+
+def main():
+    import jax
+
+    import paddle_tpu as pt
+    from paddle_tpu.flags import FLAGS
+    from paddle_tpu.ops import bahdanau_kernels as bk
+
+    exe = pt.Executor(donate_state=True)
+    for batch in (128, 256):
+        variants = {}
+        for mega in (False, True):
+            FLAGS.fused_attention_seq_bwd = mega
+            bk.reset_dispatch_stats()
+            prog, startup, loss, feed = build(batch)
+            feed = {k: jax.device_put(v) for k, v in feed.items()}
+            for v in feed.values():
+                for leaf in jax.tree.leaves(v):
+                    np.asarray(leaf.ravel()[0])
+            exe.run(startup)
+            for _ in range(3):  # first run traces under this flag value
+                (l,) = exe.run(prog, feed=feed, fetch_list=[loss])
+            assert np.isfinite(l), f"mega={mega} loss {l}"
+            want = "seq_bwd" if mega else "scan_bwd"
+            assert bk.dispatch_stats[want] >= 1, (mega, bk.dispatch_stats)
+            variants[mega] = (prog, loss, feed, float(l))
+        print(f"bs={batch} warm losses: scan={variants[False][3]:.3f} "
+              f"mega={variants[True][3]:.3f}", flush=True)
+        res = {False: [], True: []}
+        for rep in range(3):
+            for mega in (False, True):
+                prog, loss, feed, _ = variants[mega]
+                t0 = time.perf_counter()
+                for _ in range(STEPS):
+                    (l,) = exe.run(prog, feed=feed, fetch_list=[loss],
+                                   return_numpy=False)
+                float(np.asarray(l))
+                dt = (time.perf_counter() - t0) / STEPS
+                res[mega].append(dt)
+                toks = batch * SEQLEN / dt
+                print(f"bs={batch} rep{rep} mega={int(mega)}: "
+                      f"{dt*1e3:6.1f} ms/step {toks/1e3:7.1f}k tok/s",
+                      flush=True)
+        ms = sorted(res[False])[1]
+        mm = sorted(res[True])[1]
+        print(f"bs={batch}: speedup {ms/mm:.3f}x "
+              f"({batch*SEQLEN/ms/1e3:.1f}k -> {batch*SEQLEN/mm/1e3:.1f}k "
+              f"tok/s)", flush=True)
+
+
+if __name__ == "__main__":
+    main()

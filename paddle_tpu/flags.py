@@ -177,6 +177,21 @@ define_flag("fused_attention_seq_fwd", False,
             "device-side loop overhead that the kernel's T x batch-tile "
             "grid floor matches); kept tested for parts where dispatch "
             "economics differ")
+define_flag("fused_attention_seq_bwd", False,
+            "run the fused decoder's BACKWARD as one whole-sequence "
+            "pallas kernel (grid (batch-tiles, T) walking timesteps "
+            "newest-first, dh carry + d(enc_proj)/d(v) accumulators in "
+            "f32 VMEM scratch) instead of a reverse lax.scan of per-step "
+            "kernels + a separate phase-2 accumulation kernel. Off by "
+            "default: measured 0.963x at the NMT config bf16 bs128 AND "
+            "bs256 (310->299k, 316->305k tok/s, experiments/"
+            "exp_megabwd.py) — it eliminates T per-step dispatches + the "
+            "phase-2 dispatch + the [T,B,Sp] dsc HBM round-trip, but "
+            "runs the GRU-cell backward matmuls at the 8-row batch tile "
+            "(MXU ~8/128 utilized) where the scan path runs them at the "
+            "full batch; the dispatch savings don't cover that. Kept "
+            "parity-tested both ways (more accurate than the scan path "
+            "vs f64 ground truth; see PERF.md round 5)")
 define_flag("bn_bf16_stats", True,
             "batch_norm stats: square in the io dtype with f32 reduction "
             "accumulation instead of upcasting the activation first. "

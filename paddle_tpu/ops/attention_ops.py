@@ -76,6 +76,14 @@ def attention_gru_decoder_kernel(ctx):
     trg_len = ctx.attr("trg_max_len") or trg_l.capacity
     enc_b, enc_mask = enc_l.to_batch(max_len=src_len, time_major=False)  # [B,S,C]
     trg_b, trg_mask = trg_l.to_batch(max_len=trg_len)  # [T,B,E]
+    # the decoder is matmul-heavy, so its inputs cast to the amp dtype
+    # like fc's do (amp.py design: MXU op inputs cast down, activations
+    # flow at 2 bytes). trg_emb arrives f32 straight from the embedding
+    # gather — without this cast it silently pinned the WHOLE decoder
+    # (and the fused kernels' [B,S,A] streams) to f32 under AMP
+    from .. import amp
+
+    trg_b = amp.cast_inputs(ctx, trg_b)
     # uniform compute dtype under amp: f32 master params cast down to the
     # activation dtype so the scan carry dtype is stable (see rnn_ops)
     dt = trg_b.dtype

@@ -99,34 +99,197 @@ def test_fused_decoder_forward_parity(interpret_flag, seq_fwd):
         FLAGS.fused_attention_seq_fwd = prev
 
 
-def test_fused_decoder_gradient_parity(interpret_flag):
-    args = _make_inputs()
-    # differentiate wrt everything float except the masks (idx 2, 4)
-    argnums = (0, 1, 3, 5, 6, 7, 8, 9, 10)
-    names = ["enc_b", "enc_proj", "trg_b", "h0", "wa_dec", "v_att",
-             "wx", "wh", "bias"]
+@pytest.mark.parametrize("seq_bwd", [True, False])
+def test_fused_decoder_gradient_parity(interpret_flag, seq_bwd):
+    """Both backward formulations — the reverse scan of per-step kernels
+    (default) and the whole-sequence mega kernel — reproduce every
+    gradient of the XLA scan. (The mega kernel ships off by default —
+    measured 0.963x, benchmarks/bahdanau_megabwd.json — but stays
+    parity-tested: vs f64 ground truth it is the MORE accurate path.)"""
+    from paddle_tpu.ops import bahdanau_kernels as bk
 
-    def loss(fn):
-        def f(*diff_args):
-            full = list(args)
-            for i, a in zip(argnums, diff_args):
-                full[i] = a
-            h = fn(*full)
-            # nonuniform readout so every position/feature matters
-            w = jnp.arange(h.size, dtype=h.dtype).reshape(h.shape) * 1e-4
-            return jnp.sum(h * jnp.sin(w))
-        return f
+    prev = FLAGS.fused_attention_seq_bwd
+    FLAGS.fused_attention_seq_bwd = seq_bwd
+    bk.reset_dispatch_stats()
+    try:
+        args = _make_inputs()
+        # differentiate wrt everything float except the masks (idx 2, 4)
+        argnums = (0, 1, 3, 5, 6, 7, 8, 9, 10)
+        names = ["enc_b", "enc_proj", "trg_b", "h0", "wa_dec", "v_att",
+                 "wx", "wh", "bias"]
 
-    diff_args = tuple(args[i] for i in argnums)
-    g_ref = jax.grad(loss(_scan_decoder), argnums=tuple(range(len(argnums))))(
-        *diff_args)
-    g_got = jax.grad(loss(fused_attention_decoder),
-                     argnums=tuple(range(len(argnums))))(*diff_args)
-    for name, a, b in zip(names, g_got, g_ref):
-        scale = max(1e-3, float(np.abs(np.asarray(b)).max()))
-        np.testing.assert_allclose(
-            np.asarray(a), np.asarray(b), rtol=5e-4, atol=5e-4 * scale,
-            err_msg=f"grad {name}")
+        def loss(fn):
+            def f(*diff_args):
+                full = list(args)
+                for i, a in zip(argnums, diff_args):
+                    full[i] = a
+                h = fn(*full)
+                # nonuniform readout so every position/feature matters
+                w = jnp.arange(h.size, dtype=h.dtype).reshape(h.shape) * 1e-4
+                return jnp.sum(h * jnp.sin(w))
+            return f
+
+        diff_args = tuple(args[i] for i in argnums)
+        g_ref = jax.grad(loss(_scan_decoder),
+                         argnums=tuple(range(len(argnums))))(*diff_args)
+        g_got = jax.grad(loss(fused_attention_decoder),
+                         argnums=tuple(range(len(argnums))))(*diff_args)
+        for name, a, b in zip(names, g_got, g_ref):
+            scale = max(1e-3, float(np.abs(np.asarray(b)).max()))
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=5e-4, atol=5e-4 * scale,
+                err_msg=f"grad {name}")
+        want = "seq_bwd" if seq_bwd else "scan_bwd"
+        assert bk.dispatch_stats[want] >= 1, bk.dispatch_stats
+    finally:
+        FLAGS.fused_attention_seq_bwd = prev
+
+
+@pytest.mark.parametrize("seq_bwd", [True, False])
+def test_fused_decoder_bf16_parity(interpret_flag, seq_bwd):
+    """bf16 io (what the decoder actually runs under AMP since the
+    round-5 cast fix) compiles and tracks the bf16 XLA scan — through
+    BOTH backwards. Gradients compare at bf16-appropriate tolerance
+    (the kernels accumulate f32 in VMEM, the scan accumulates through a
+    bf16 carry — the kernels are the more accurate side, so the
+    comparison bounds kernel error)."""
+    from paddle_tpu.ops import bahdanau_kernels as bk
+
+    prev = FLAGS.fused_attention_seq_bwd
+    FLAGS.fused_attention_seq_bwd = seq_bwd
+    bk.reset_dispatch_stats()
+    try:
+        args = tuple(
+            a.astype(jnp.bfloat16)
+            if hasattr(a, "dtype") and a.dtype == jnp.float32 else a
+            for a in _make_inputs())
+        ref = _scan_decoder(*args)
+        got = fused_attention_decoder(*args)
+        np.testing.assert_allclose(np.asarray(got, np.float32),
+                                   np.asarray(ref, np.float32),
+                                   rtol=3e-2, atol=3e-2)
+
+        def loss(fn):
+            def f(enc_b, wx):
+                full = list(args)
+                full[0], full[8] = enc_b, wx
+                return jnp.sum(fn(*full).astype(jnp.float32) ** 2)
+            return f
+
+        g_ref = jax.grad(loss(_scan_decoder), argnums=(0, 1))(
+            args[0], args[8])
+        g_got = jax.grad(loss(fused_attention_decoder), argnums=(0, 1))(
+            args[0], args[8])
+        for a, b in zip(g_got, g_ref):
+            a = np.asarray(a, np.float32)
+            b = np.asarray(b, np.float32)
+            scale = max(1.0, np.abs(b).max())
+            np.testing.assert_allclose(a, b, rtol=6e-2, atol=6e-2 * scale)
+        want = "seq_bwd" if seq_bwd else "scan_bwd"
+        assert bk.dispatch_stats[want] >= 1, bk.dispatch_stats
+    finally:
+        FLAGS.fused_attention_seq_bwd = prev
+
+
+def test_bench_geometry_engages_fused_path(interpret_flag):
+    """The bench-default NMT geometries must be ELIGIBLE — a config
+    drifting off the eligibility grid (A/C alignment, batch-tile
+    divisibility) would silently fall back to the scan and the headline
+    would quietly regress (VERDICT r4 weak #3)."""
+    # bs256 bench default and bs128: S=T=50, A=512, bidirectional C=1024,
+    # bf16 under AMP (the production io dtype since round 5) and f32
+    for dtype in (jnp.bfloat16, jnp.float32):
+        assert fused_decoder_eligible(256, 50, 512, 1024, dtype)
+        assert fused_decoder_eligible(128, 50, 512, 1024, dtype)
+    # small batches stay eligible through the 8->4->2 tile ladder (legal
+    # only when the tile spans the batch dim); a batch a sub-8 tile
+    # would only DIVIDE (250 = 2 x 125) must fall back to the scan —
+    # that block shape fails Mosaic's (8k, 128k)-or-full tiling rule
+    assert fused_decoder_eligible(4, 50, 512, 1024, jnp.bfloat16)
+    assert fused_decoder_eligible(2, 50, 512, 1024, jnp.bfloat16)
+    assert not fused_decoder_eligible(250, 50, 512, 1024, jnp.bfloat16)
+    # the mega-bwd VMEM model passes at the bench geometry in bf16 (it
+    # is an opt-in path, but an ineligible default geometry would make
+    # the flag a no-op silently)
+    from paddle_tpu.ops.bahdanau_kernels import (_mega_bwd_vmem_ok,
+                                                 _pad_s)
+    assert _mega_bwd_vmem_ok(256, _pad_s(50), 512, 1024, 512, 2)
+    # and the fused path actually DISPATCHES at the bench geometry, not
+    # just passes the predicate: trace the decoder fwd+bwd at the real
+    # shapes (jax.eval_shape — abstract, no FLOPs) and assert the
+    # trace-time counters fired. A trace-time condition diverging from
+    # the eligibility predicate would slip past the asserts above.
+    from paddle_tpu.ops import bahdanau_kernels as bk
+
+    B, S, T, E, C, A, H = 256, 50, 50, 512, 1024, 512, 512
+    dt = jnp.bfloat16
+    shapes = (
+        jax.ShapeDtypeStruct((B, S, C), dt),            # enc_b
+        jax.ShapeDtypeStruct((B, S, A), dt),            # enc_proj
+        jax.ShapeDtypeStruct((B, S), jnp.bool_),        # enc_mask
+        jax.ShapeDtypeStruct((T, B, E), dt),            # trg_b
+        jax.ShapeDtypeStruct((T, B), jnp.float32),      # trg_mask
+        jax.ShapeDtypeStruct((B, H), dt),               # h0
+        jax.ShapeDtypeStruct((H, A), dt),               # wa_dec
+        jax.ShapeDtypeStruct((A,), dt),                 # v_att
+        jax.ShapeDtypeStruct((E + C, 3 * H), dt),       # wx
+        jax.ShapeDtypeStruct((H, 3 * H), dt),           # wh
+        jax.ShapeDtypeStruct((3 * H,), dt),             # bias
+    )
+    bk.reset_dispatch_stats()
+
+    def loss(enc_b, ep, *rest):
+        return jnp.sum(
+            fused_attention_decoder(enc_b, ep, *rest).astype(jnp.float32))
+
+    jax.eval_shape(jax.grad(loss, argnums=(0, 1)), *shapes)
+    assert bk.dispatch_stats["fused_calls"] >= 1, bk.dispatch_stats
+    assert bk.dispatch_stats["scan_bwd"] >= 1, bk.dispatch_stats
+
+
+def test_decoder_applies_amp_cast(interpret_flag):
+    """Under Program.set_amp the decoder op must cast its io to the amp
+    dtype: trg_emb arrives f32 straight from the embedding gather and
+    would otherwise pin the whole decoder — and the fused kernels'
+    [B, S, A] streams — to f32 (round-5 fix; moved the NMT headline
+    262k -> 324k tok/s)."""
+    import paddle_tpu as pt
+    from paddle_tpu import models
+    from paddle_tpu.core.lod import LoDArray
+    from paddle_tpu.ops import bahdanau_kernels as bk
+
+    seen = []
+    orig = bk.fused_decoder_eligible
+
+    def spy(B, S, A, C, dtype):
+        seen.append(jnp.dtype(dtype))
+        return orig(B, S, A, C, dtype)
+
+    bk.fused_decoder_eligible = spy
+    try:
+        pt.reset()
+        B, S, vocab = 8, 12, 64
+        src = pt.layers.data("src", shape=[-1], dtype=np.int32, lod_level=1,
+                             append_batch_size=False)
+        trg_in = pt.layers.data("trg_in", shape=[-1], dtype=np.int32,
+                                lod_level=1, append_batch_size=False)
+        logits = models.seq2seq_attention(
+            src, trg_in, src_vocab=vocab, trg_vocab=vocab, emb_dim=128,
+            enc_hidden=128, dec_hidden=128, src_max_len=S, trg_max_len=S)
+        prog = pt.default_main_program()
+        prog.set_amp("bfloat16")
+        exe = pt.Executor()
+        exe.run(pt.default_startup_program())
+        rng = np.random.RandomState(0)
+        pack = lambda seqs: LoDArray.from_sequences(  # noqa: E731
+            seqs, capacity=B * S, max_seqs=B)
+        seqs = [rng.randint(2, vocab, (S,)).astype(np.int32)
+                for _ in range(B)]
+        exe.run(feed={"src": pack(seqs), "trg_in": pack(seqs)},
+                fetch_list=[logits])
+        assert seen and all(d == jnp.bfloat16 for d in seen), seen
+    finally:
+        bk.fused_decoder_eligible = orig
 
 
 def test_fused_decoder_in_model(interpret_flag):
