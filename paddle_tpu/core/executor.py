@@ -293,6 +293,15 @@ class Executor:
     def _device_context(self):
         return jax.default_device(self.place.device)
 
+    def _trace_context(self):
+        """Hook: context active while the jitted step traces/runs. The
+        ParallelExecutor overrides this to declare its mesh to the
+        fused-kernel dispatch layer (ops/mesh_dispatch.py), which then
+        shard_maps eligible pallas calls over the dp axis."""
+        import contextlib
+
+        return contextlib.nullcontext()
+
     # ------------------------------------------------------------------
     def run(
         self,
@@ -324,9 +333,18 @@ class Executor:
             program.version,
             program.amp_dtype,
             program.remat_policy,
-            # trace-affecting flags (both feed pallas_kernels dispatch)
+            # trace-affecting flags (all feed fused-kernel dispatch)
             FLAGS.use_fused_rnn,
             FLAGS.fused_rnn_interpret,
+            FLAGS.use_fused_attention,
+            FLAGS.fused_attention_interpret,
+            FLAGS.fused_attention_seq_fwd,
+            FLAGS.fused_attention_seq_bwd,
+            FLAGS.use_fused_conv,
+            FLAGS.fused_conv_pallas,
+            FLAGS.fused_conv_interpret,
+            FLAGS.fused_conv_dot_max_n,
+            FLAGS.stacked_lstm_single_scan,
             _feed_signature(feed),
             tuple(fetch_names),
             tuple(persist_names),
@@ -343,7 +361,7 @@ class Executor:
         state = {n: scope.get(n) for n in persist_names}
         seed = jnp.asarray(self._draw_seed(program), dtype=jnp.uint32)
         state, feed, seed = self._place_inputs(program, state, feed, seed)
-        with self._device_context():
+        with self._device_context(), self._trace_context():
             fetches, new_state = fn(state, feed, seed)
         if FLAGS.check_nan_inf:
             # reference: CheckTensorNANOrInf per op output behind

@@ -98,9 +98,13 @@ def attention_gru_decoder_kernel(ctx):
 
     from .bahdanau_kernels import (fused_attention_decoder,
                                    fused_decoder_eligible)
+    from .mesh_dispatch import local_batch
 
     B, S, A = enc_proj.shape
-    if fused_decoder_eligible(B, S, A, enc_b.shape[-1], enc_b.dtype):
+    # under a mesh the kernels run per-shard (shard_map): eligibility is
+    # judged at the batch each shard actually sees
+    if fused_decoder_eligible(local_batch(B), S, A, enc_b.shape[-1],
+                              enc_b.dtype):
         # fused path: score+softmax+context in VMEM, whole-scan custom
         # VJP (bahdanau_kernels.py) — never materializes [B, S, A]
         h_seq = fused_attention_decoder(

@@ -78,6 +78,8 @@ def _bblk(B: int, Sp: int, A: int, C: int, itemsize: int) -> int:
     scan."""
     import os
 
+    if B <= 0:  # mesh-local batch that the dp axis does not divide
+        return 0
     forced = int(os.environ.get("PT_ATTN_BBLK", 0))
     for b in ((forced,) if forced else (8, 4, 2)):
         if (B % b == 0 and (b % 8 == 0 or b == B)
@@ -638,11 +640,16 @@ def _gru_fwd_step(xp, h_prev, wh, H):
 
 
 @functools.lru_cache(maxsize=None)
-def _decoder_fn(interpret: bool):
+def _decoder_fn(interpret: bool, axis=None):
     """custom-VJP'd teacher-forcing decoder over padded-S operands.
 
     (enc, ep, maskf [B,Sp], trg [T,B,E], tmask [T,B], h0,
      wa_dec [H,A], v [A], wx [(E+C),3H], wh [H,3H], bias [3H]) -> h_seq.
+
+    `axis` names the dp shard_map axis when the call runs under a mesh
+    (mesh_dispatch policy): operands are then per-shard, and the weight
+    cotangents — per-shard partial sums over the local batch — are
+    psum'd in the backward (check_vma is off, so no automatic psum).
     """
 
     def forward(enc, ep, maskf, trg, tmask, h0, wa_dec, v, wx, wh, bias):
@@ -778,6 +785,12 @@ def _decoder_fn(interpret: bool):
         dwa_dec = jnp.einsum("tbh,tba->ha", hp_seq, ddp_seq)
         denc = jnp.einsum("tbs,tbc->bsc", alpha_seq.astype(dt),
                           dctx_seq).astype(enc.dtype)
+        dv = dv.astype(jnp.float32)
+        if axis is not None:
+            # replicated-weight cotangents: per-shard partials -> global
+            dwx, dbias, dwh, dwa_dec, dv = (
+                jax.lax.psum(g, axis)
+                for g in (dwx, dbias, dwh, dwa_dec, dv))
         return (denc, dep, jnp.zeros_like(maskf), dx_seq,
                 jnp.zeros_like(tmask), dh0, dwa_dec.astype(wa_dec.dtype),
                 dv.astype(v.dtype), dwx.astype(wx.dtype),
@@ -803,6 +816,15 @@ def fused_attention_decoder(enc_b, enc_proj, enc_mask, trg_b, trg_mask,
     if bias is None:
         bias = jnp.zeros((wx.shape[1],), trg_b.dtype)
     dispatch_stats["fused_calls"] += 1
-    f = _decoder_fn(_interpret())
-    return f(enc, ep, maskf, trg_b, trg_mask.astype(jnp.float32),
-             h0, wa_dec, v_att, wx, wh, bias)
+    from . import mesh_dispatch
+
+    am = mesh_dispatch.current()
+    # axis only when shard_batch will actually wrap (dp > 1)
+    f = _decoder_fn(_interpret(),
+                    am.batch_axis if am and am.dp > 1 else None)
+    # mesh policy (ops/mesh_dispatch.py): the kernels run per-shard
+    # under shard_map — batch-sharded operands, replicated weights
+    call = mesh_dispatch.shard_batch(
+        f, (0, 0, 0, 1, 1, 0, None, None, None, None, None), ((1, 3),))
+    return call(enc, ep, maskf, trg_b, trg_mask.astype(jnp.float32),
+                h0, wa_dec, v_att, wx, wh, bias)

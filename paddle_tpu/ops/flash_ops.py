@@ -150,6 +150,25 @@ def flash_attention(q, k, v, causal: bool = False):
         raise ValueError(f"expected [B, T, H, D], got {q.shape}")
     if not flash_eligible(q, k):
         return _reference(q, k, v, causal)
+    from . import mesh_dispatch
+
+    am = mesh_dispatch.current()
+    if am is not None and am.dp > 1:
+        # mesh policy (ops/mesh_dispatch.py): a bare pallas_call cannot
+        # be GSPMD-partitioned, so the kernel shard_maps over dp (batch
+        # dim 0; no weights -> no cotangent psums). Under an mp axis the
+        # wrap replicates heads (a resharding GSPMD inserts); sharding
+        # heads over mp inside the wrap is a future multi-chip lever.
+        # A batch dp does not divide falls back to the XLA formulation,
+        # which GSPMD partitions natively.
+        if q.shape[0] % am.dp:
+            return _reference(q, k, v, causal)
+        import functools
+
+        call = mesh_dispatch.shard_batch(
+            functools.partial(_flash_kernel, causal=causal),
+            (0, 0, 0), ((0, 4),))
+        return call(q, k, v)
     return _flash_kernel(q, k, v, causal)
 
 

@@ -340,6 +340,15 @@ def fused_conv_bn_kernel(ctx):
 
     dot_max_n = FLAGS.fused_conv_dot_max_n
     use_pallas = FLAGS.fused_conv_pallas or FLAGS.fused_conv_interpret
+    from .mesh_dispatch import current as _active_mesh
+
+    if _active_mesh() is not None and _active_mesh().dp > 1:
+        # mesh policy (ops/mesh_dispatch.py): a bare pallas_call cannot
+        # be GSPMD-partitioned. This opt-in kernel (measured slower than
+        # XLA's fusion anyway — PERF.md r4) is not shard_map-wrapped;
+        # under a mesh it falls back to the identical-semantics jnp
+        # formulation, which GSPMD partitions natively
+        use_pallas = False
     if n <= dot_max_n and fused_conv_eligible(n, cin, cout, xc.dtype):
         if use_pallas:
             y2, s, sq = fused_matmul_bn(

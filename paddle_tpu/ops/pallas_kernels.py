@@ -34,6 +34,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from . import mesh_dispatch
+
 
 def _interpret() -> bool:
     return jax.default_backend() != "tpu"
@@ -87,7 +89,7 @@ def lstm_supported(B: int, H: int, gate_act, cell_act, cand_act, peep,
         and gate_act == "sigmoid"
         and cell_act == "tanh"
         and cand_act == "tanh"
-        and B % 8 == 0
+        and B >= 8 and B % 8 == 0
         and H % 128 == 0
         # measured window (benchmarks/rnn_kernel_microbench.json, round 3
         # with the outer-einsum dW past H=640): 1.02x at H=512, 1.45x at
@@ -107,7 +109,7 @@ def gru_supported(B: int, H: int, gate_act, cand_act,
     return (
         gate_act == "sigmoid"
         and cand_act == "tanh"
-        and B % 8 == 0
+        and B >= 8 and B % 8 == 0
         and H % 128 == 0
         # measured window (benchmarks/rnn_kernel_microbench.json, round 3
         # with the hand-written reverse-time backward kernel replacing the
@@ -364,7 +366,10 @@ def lstm_fused(x_tbh, mask, w_rec, bias=None, reverse=False):
     """Fused LSTM over the whole sequence (zero-boot, sigmoid/tanh).
 
     Mirrors lstm_scan's signature subset: optional pre-gate bias and
-    time reversal (flip in, flip the emitted sequence back)."""
+    time reversal (flip in, flip the emitted sequence back). Under an
+    active mesh the call is shard_map'd over the dp axis (mesh_dispatch
+    policy): batch-sharded x/mask, replicated weight, per-shard kernel
+    at the local batch, dW psum'd in the backward."""
     if bias is not None:
         # master-weight bias casts DOWN to the activation dtype (amp):
         # promoting x to f32 here would double the whole sequence's HBM
@@ -373,33 +378,53 @@ def lstm_fused(x_tbh, mask, w_rec, bias=None, reverse=False):
     # f32 master weight likewise meets the activation dtype at the kernel
     # boundary; the cast's transpose restores an f32 dW for the optimizer
     w_rec = w_rec.astype(x_tbh.dtype)
+    am = mesh_dispatch.current()
+    # axis only when shard_batch will actually wrap (dp > 1): a dp=1
+    # mesh runs unwrapped, where a psum over the axis name is unbound
+    core = _lstm_core(am.batch_axis if am and am.dp > 1 else None)
+    # outputs (h_seq [T,B,H], (h_T [B,H], c_T [B,H]))
+    call = mesh_dispatch.shard_batch(
+        core, (1, 1, None), ((1, 3), (0, 2), (0, 2)),
+        out_tree=_RNN_LSTM_OUT_TREE)
     if reverse:
-        h_seq, last = _lstm_fused_core(x_tbh[::-1], mask[::-1], w_rec)
+        h_seq, last = call(x_tbh[::-1], mask[::-1], w_rec)
         return h_seq[::-1], last
-    return _lstm_fused_core(x_tbh, mask, w_rec)
+    return call(x_tbh, mask, w_rec)
 
 
-@jax.custom_vjp
-def _lstm_fused_core(x_tbh, mask, w_rec):
-    h_seq, _c_seq, h_T, c_T = _lstm_pallas_raw(x_tbh, mask, w_rec)
-    return h_seq, (h_T, c_T)
+_RNN_LSTM_OUT_TREE = jax.tree.structure((0, (0, 0)))
+_RNN_GRU_OUT_TREE = jax.tree.structure((0, 0))
 
 
-def _lstm_fwd(x_tbh, mask, w_rec):
-    h_seq, c_seq, h_T, c_T = _lstm_pallas_raw(x_tbh, mask, w_rec)
-    return (h_seq, (h_T, c_T)), (x_tbh, mask, w_rec, h_seq, c_seq)
+@functools.lru_cache(maxsize=None)
+def _lstm_core(axis):
+    """custom-VJP fused LSTM; `axis` names the dp shard_map axis (None =
+    unsharded). The weight cotangent is a per-shard partial sum, so the
+    backward psums it over `axis` — shard_map runs with check_vma off
+    (pallas calls carry no replication rule), which disables the
+    automatic cotangent psum for replicated inputs."""
 
+    @jax.custom_vjp
+    def core(x_tbh, mask, w_rec):
+        h_seq, _c_seq, h_T, c_T = _lstm_pallas_raw(x_tbh, mask, w_rec)
+        return h_seq, (h_T, c_T)
 
-def _lstm_bwd(res, ct):
-    x_tbh, mask, w_rec, h_seq, c_seq = res
-    dh_seq, (dhT, dcT) = ct
-    dx, dw = _lstm_bwd_pallas(
-        x_tbh, mask, w_rec, h_seq, c_seq, dh_seq, dhT, dcT
-    )
-    return dx, None, dw
+    def fwd(x_tbh, mask, w_rec):
+        h_seq, c_seq, h_T, c_T = _lstm_pallas_raw(x_tbh, mask, w_rec)
+        return (h_seq, (h_T, c_T)), (x_tbh, mask, w_rec, h_seq, c_seq)
 
+    def bwd(res, ct):
+        x_tbh, mask, w_rec, h_seq, c_seq = res
+        dh_seq, (dhT, dcT) = ct
+        dx, dw = _lstm_bwd_pallas(
+            x_tbh, mask, w_rec, h_seq, c_seq, dh_seq, dhT, dcT
+        )
+        if axis is not None:
+            dw = jax.lax.psum(dw, axis)
+        return dx, None, dw
 
-_lstm_fused_core.defvjp(_lstm_fwd, _lstm_bwd)
+    core.defvjp(fwd, bwd)
+    return core
 
 
 # ------------------------------------------------------------------- GRU ---
@@ -623,32 +648,43 @@ def _gru_bwd_pallas(x_tbh, mask, w_rec, h_seq, dh_seq, dhT):
 
 
 def gru_fused(x_tbh, mask, w_rec, bias=None, reverse=False):
-    """Fused GRU over the whole sequence (zero-boot, sigmoid/tanh)."""
+    """Fused GRU over the whole sequence (zero-boot, sigmoid/tanh).
+
+    Mesh policy as lstm_fused: shard_map'd over dp when a mesh is
+    active, dW psum'd in the backward."""
     if bias is not None:
         x_tbh = x_tbh + bias.astype(x_tbh.dtype)  # see lstm_fused
     w_rec = w_rec.astype(x_tbh.dtype)
+    am = mesh_dispatch.current()
+    core = _gru_core(am.batch_axis if am and am.dp > 1 else None)  # see lstm_fused
+    call = mesh_dispatch.shard_batch(
+        core, (1, 1, None), ((1, 3), (0, 2)), out_tree=_RNN_GRU_OUT_TREE)
     if reverse:
-        h_seq, h_T = _gru_fused_core(x_tbh[::-1], mask[::-1], w_rec)
+        h_seq, h_T = call(x_tbh[::-1], mask[::-1], w_rec)
         return h_seq[::-1], h_T
-    return _gru_fused_core(x_tbh, mask, w_rec)
+    return call(x_tbh, mask, w_rec)
 
 
-@jax.custom_vjp
-def _gru_fused_core(x_tbh, mask, w_rec):
-    h_seq, h_T = _gru_pallas_raw(x_tbh, mask, w_rec)
-    return h_seq, h_T
+@functools.lru_cache(maxsize=None)
+def _gru_core(axis):
+    """custom-VJP fused GRU; see _lstm_core for the axis/psum contract."""
 
+    @jax.custom_vjp
+    def core(x_tbh, mask, w_rec):
+        h_seq, h_T = _gru_pallas_raw(x_tbh, mask, w_rec)
+        return h_seq, h_T
 
-def _gru_fwd(x_tbh, mask, w_rec):
-    h_seq, h_T = _gru_pallas_raw(x_tbh, mask, w_rec)
-    return (h_seq, h_T), (x_tbh, mask, w_rec, h_seq)
+    def fwd(x_tbh, mask, w_rec):
+        h_seq, h_T = _gru_pallas_raw(x_tbh, mask, w_rec)
+        return (h_seq, h_T), (x_tbh, mask, w_rec, h_seq)
 
+    def bwd(res, ct):
+        x_tbh, mask, w_rec, h_seq = res
+        dh_seq, dhT = ct
+        dx, dw = _gru_bwd_pallas(x_tbh, mask, w_rec, h_seq, dh_seq, dhT)
+        if axis is not None:
+            dw = jax.lax.psum(dw, axis)
+        return dx, None, dw
 
-def _gru_bwd(res, ct):
-    x_tbh, mask, w_rec, h_seq = res
-    dh_seq, dhT = ct
-    dx, dw = _gru_bwd_pallas(x_tbh, mask, w_rec, h_seq, dh_seq, dhT)
-    return dx, None, dw
-
-
-_gru_fused_core.defvjp(_gru_fwd, _gru_bwd)
+    core.defvjp(fwd, bwd)
+    return core

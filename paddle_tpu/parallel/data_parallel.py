@@ -60,6 +60,16 @@ class ParallelExecutor(Executor):
         # HBM for optimizer state drops by ~dp_size.
         self.shard_optimizer_state = shard_optimizer_state
 
+    def _trace_context(self):
+        """Declare the mesh to the fused-kernel dispatch layer: pallas
+        calls cannot be auto-partitioned by GSPMD, so eligible kernels
+        shard_map themselves over the batch axis (ops/mesh_dispatch.py
+        — the written pallas-under-mesh policy) and eligibility windows
+        evaluate at the per-shard batch."""
+        from ..ops import mesh_dispatch
+
+        return mesh_dispatch.active_mesh(self.mesh, self.batch_axis)
+
     # -- sharding rules -----------------------------------------------------
     def _state_sharding(self, program: Program, name: str) -> NamedSharding:
         gb = program.global_block()
