@@ -192,6 +192,17 @@ define_flag("fused_attention_seq_bwd", False,
             "full batch; the dispatch savings don't cover that. Kept "
             "parity-tested both ways (more accurate than the scan path "
             "vs f64 ground truth; see PERF.md round 5)")
+define_flag("stacked_lstm_single_scan", False,
+            "run the N-layer stacked_lstm op as ONE all-layers masked "
+            "scan (the stacked_lstm2 lever generalized). Off by "
+            "default: the book's [4H,4H] inter-layer concat-fc "
+            "sequentializes in-scan where the default layer-by-layer "
+            "formulation runs it as one [T*B,4H] batched matmul, and "
+            "measured at the book config (hid=128 bs128, experiments/"
+            "exp_stacked_book.py) neither formulation separates from "
+            "the noise floor (0.79x-1.30x across identical runs — "
+            "benchmarks/stacked_book.json), so the batched default "
+            "stands on the structural argument")
 define_flag("bn_bf16_stats", True,
             "batch_norm stats: square in the io dtype with f32 reduction "
             "accumulation instead of upcasting the activation first. "

@@ -18,6 +18,7 @@ from .helper import LayerHelper
 __all__ = [
     "dynamic_lstm",
     "stacked_lstm2",
+    "stacked_lstm",
     "dynamic_gru",
     "simple_rnn",
     "sequence_pool",
@@ -134,6 +135,65 @@ def stacked_lstm2(
         attrs={"max_len": max_len},
     )
     return out
+
+
+def stacked_lstm(
+    input,
+    size: int,
+    stacked_num: int,
+    param_attr=None,
+    bias_attr=None,
+    max_len: Optional[int] = None,
+    name=None,
+):
+    """N stacked LSTM layers with the book's inter-layer structure
+    (understand_sentiment stacked_lstm_net: each layer's input is
+    fc([fc_prev, lstm_prev])) in ONE op — the N-layer generalization of
+    stacked_lstm2's single-scan lever (PERF.md r4/r5). `size` is
+    4*hidden; `input` is the layer-1 [*, 4H] projection (the book's
+    fc1). Returns (fc_out, hidden): the LAST inter-layer fc sequence
+    and the last layer's hidden sequence — the book max-pools both.
+    Dispatch (trace time): per-layer fused Pallas kernels where
+    eligible, else a single scan carrying the whole stack's state.
+    `max_len` semantics as stacked_lstm2."""
+    from ..param_attr import ParamAttr
+
+    if stacked_num < 2:
+        raise ValueError(f"stacked_num must be >= 2, got {stacked_num}")
+    helper = LayerHelper("stacked_lstm", name=name)
+    hidden = size // 4
+    xav = XavierInitializer()
+    mk = lambda suffix, shape: helper.create_parameter(  # noqa: E731
+        ParamAttr.derive(param_attr, helper.name, suffix), shape,
+        default_initializer=xav)
+    # creation order matches the per-layer book build (w0, then per
+    # layer wa_i, wb_i, w_{i+1}): the init RNG folds in a sequential
+    # per-draw counter, so identical names AND identical draw order are
+    # both required for init parity with the unfused formulation
+    ws = [mk("w0", (hidden, 4 * hidden))]
+    was, wbs = [], []
+    for i in range(stacked_num - 1):
+        was.append(mk(f"wa{i}", (4 * hidden, 4 * hidden)))
+        wbs.append(mk(f"wb{i}", (hidden, 4 * hidden)))
+        ws.append(mk(f"w{i + 1}", (hidden, 4 * hidden)))
+    inputs = {"Input": [input], "Weights": ws, "WAs": was, "WBs": wbs}
+    if bias_attr is not False:
+        mkb = lambda suffix: helper.create_parameter(  # noqa: E731
+            ParamAttr.derive(bias_attr, helper.name, suffix),
+            (4 * hidden,), is_bias=True)
+        inputs["Biases"] = [mkb(f"b{i}") for i in range(stacked_num)]
+        inputs["FcBiases"] = [mkb(f"fb{i}")
+                              for i in range(stacked_num - 1)]
+    fc_out = helper.create_tmp_variable(input.dtype, (-1, 4 * hidden),
+                                        lod_level=1)
+    out = helper.create_tmp_variable(input.dtype, (-1, hidden), lod_level=1)
+    helper.append_op(
+        type="stacked_lstm",
+        inputs=inputs,
+        outputs={"FcOut": [fc_out], "Hidden": [out]},
+        attrs={"max_len": max_len},
+    )
+    return fc_out, out
 
 
 def dynamic_gru(

@@ -93,3 +93,112 @@ def test_stacked_lstm_in_benchmark_net():
         (l,) = exe.run(feed=feed, fetch_list=[loss])
         ls.append(float(l))
     assert np.isfinite(ls).all() and ls[-1] < ls[0]
+
+
+def _build_n(stacked, N=3, H=8, F=12):
+    """N-layer book-structure stack (understand_sentiment) as ONE op vs
+    the per-layer fc+dynamic_lstm build, shared parameter names."""
+    pt.reset()
+    pt.default_startup_program().random_seed = 3
+    x = pt.layers.data("x", shape=[F], lod_level=1)
+    y = pt.layers.data("y", shape=[1])
+    proj1 = pt.layers.fc(x, size=4 * H, bias_attr=False,
+                         param_attr=pt.ParamAttr(name="proj1"))
+    if stacked:
+        fc_seq, h_seq = pt.layers.stacked_lstm(
+            proj1, size=4 * H, stacked_num=N,
+            param_attr=pt.ParamAttr(name="s"),
+            bias_attr=pt.ParamAttr(name="sb"))
+    else:
+        fc_prev = proj1
+        h_prev = pt.layers.dynamic_lstm(
+            proj1, size=4 * H, param_attr=pt.ParamAttr(name="s.w0"),
+            bias_attr=pt.ParamAttr(name="sb.b0"))
+        for i in range(N - 1):
+            fc_prev = pt.layers.fc(
+                [fc_prev, h_prev], size=4 * H,
+                param_attr=[pt.ParamAttr(name=f"s.wa{i}"),
+                            pt.ParamAttr(name=f"s.wb{i}")],
+                bias_attr=pt.ParamAttr(name=f"sb.fb{i}"))
+            h_prev = pt.layers.dynamic_lstm(
+                fc_prev, size=4 * H,
+                param_attr=pt.ParamAttr(name=f"s.w{i + 1}"),
+                bias_attr=pt.ParamAttr(name=f"sb.b{i + 1}"))
+        fc_seq, h_seq = fc_prev, h_prev
+    pooled_fc = pt.layers.sequence_pool(fc_seq, "max")
+    pooled_h = pt.layers.sequence_pool(h_seq, "max")
+    pred = pt.layers.fc([pooled_fc, pooled_h], size=1,
+                        param_attr=[pt.ParamAttr(name="out_a"),
+                                    pt.ParamAttr(name="out_b")])
+    loss = pt.layers.mean(pt.layers.square_error_cost(pred, y))
+    pt.optimizer.Adam(learning_rate=0.01).minimize(loss)
+    return loss
+
+
+import pytest
+
+
+@pytest.mark.parametrize("single_scan", [False, True])
+def test_stacked_n_matches_per_layer_build(single_scan):
+    """The N-layer single-op stack reproduces the book's per-layer
+    fc([fc_prev, lstm_prev]) + dynamic_lstm build exactly (same weight
+    names -> identical init -> identical losses over Adam steps) — in
+    BOTH op formulations (layer-by-layer default and the flag-gated
+    all-layers single scan)."""
+    from paddle_tpu.flags import FLAGS
+
+    feed = _feed()
+    results = {}
+    for stacked in (False, True):
+        FLAGS.stacked_lstm_single_scan = stacked and single_scan
+        try:
+            loss = _build_n(stacked)
+            exe = pt.Executor()
+            exe.run(pt.default_startup_program())
+            ls = []
+            for _ in range(4):
+                (l,) = exe.run(feed=feed, fetch_list=[loss])
+                ls.append(float(l))
+            results[stacked] = ls
+        finally:
+            FLAGS.stacked_lstm_single_scan = False
+    np.testing.assert_allclose(results[True], results[False],
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_stacked_n_fused_path_matches_scan():
+    """The fused multi-layer branch (per-layer Pallas kernels + batched
+    inter-layer matmuls) vs the single all-layers scan, at an in-window
+    geometry (H=512, B=8) with a dispatch spy — the fused branch must
+    actually ENGAGE, not silently compare scan to scan."""
+    from paddle_tpu.flags import FLAGS
+    from paddle_tpu.ops import pallas_kernels
+
+    feed = _feed(B=8)
+    results = {}
+    kernel_calls = {False: 0, True: 0}
+    orig = pallas_kernels._lstm_pallas_raw
+    for interp in (False, True):
+        FLAGS.fused_rnn_interpret = interp
+
+        def spy(*a, **k):
+            kernel_calls[interp] += 1
+            return orig(*a, **k)
+
+        pallas_kernels._lstm_pallas_raw = spy
+        try:
+            loss = _build_n(True, H=512)
+            exe = pt.Executor()
+            exe.run(pt.default_startup_program())
+            ls = []
+            for _ in range(3):
+                (l,) = exe.run(feed=feed, fetch_list=[loss])
+                ls.append(float(l))
+            results[interp] = ls
+        finally:
+            pallas_kernels._lstm_pallas_raw = orig
+            FLAGS.fused_rnn_interpret = False
+    assert kernel_calls[True] >= 3, kernel_calls  # one kernel per layer
+    assert kernel_calls[False] == 0, kernel_calls
+    np.testing.assert_allclose(results[True], results[False],
+                               rtol=2e-4, atol=2e-4)
