@@ -1,0 +1,128 @@
+"""Decompose the LSTM headline step (h512 bs128 T100 bf16, 2-layer
+stacked, fused kernels) into its bound parts, for the PERF.md ceiling
+model. Measures, same-process chained:
+
+  A. full bench-equivalent train step (staged feed, Adam)
+  B. the recurrence alone: 2x lstm_fused fwd+bwd (jax.grad through both
+     layers + inter-layer projection, dgates consumed)
+  C. the batched remainder: embedding + x-projection + logits head + CE
+     + Adam on a precomputed recurrence output (what A minus B leaves)
+
+Per-grid-step latency = B / (4*T grid steps + the bwd's batched
+recompute); the ceiling statement lives in PERF.md "Round 5: the
+headline ceiling model".
+Run on TPU: python experiments/exp_lstm_ceiling.py
+"""
+import os
+import time
+
+import numpy as np
+
+STEPS = int(os.environ.get("STEPS", 60))
+T, B, H, E, V = 100, 128, 512, 128, 30000
+
+
+def timed(fn, *args):
+    import jax
+
+    out = fn(*args)
+    jax.tree.leaves(out)
+    np.asarray(jax.tree.leaves(out)[0].ravel()[0])
+    t0 = time.perf_counter()
+    for _ in range(STEPS):
+        out = fn(*args)
+    np.asarray(jax.tree.leaves(out)[0].ravel()[0])
+    return (time.perf_counter() - t0) / STEPS
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.ops import pallas_kernels as pk
+
+    rng = np.random.RandomState(0)
+    dt = jnp.bfloat16
+    toks = jnp.asarray(rng.randint(0, V, (B, T)), jnp.int32)
+    emb = jnp.asarray(rng.randn(V, E) * 0.1, dt)
+    wx1 = jnp.asarray(rng.randn(E, 4 * H) * 0.02, dt)
+    w1 = jnp.asarray(rng.randn(H, 4 * H) * 0.02, dt)
+    wx2 = jnp.asarray(rng.randn(H, 4 * H) * 0.02, dt)
+    w2 = jnp.asarray(rng.randn(H, 4 * H) * 0.02, dt)
+    wo = jnp.asarray(rng.randn(H, 2) * 0.02, dt)
+    mask = jnp.ones((T, B), jnp.float32)
+    labels = jnp.asarray(rng.randint(0, 2, (B,)), jnp.int32)
+
+    # B: recurrence alone (2 fused kernels + inter-layer matmul),
+    # fwd+bwd with the gradient consumed
+    @jax.jit
+    def recurrence(x_tbh, w1, wx2, w2):
+        def f(x_tbh, w1, wx2, w2):
+            h1, _ = pk.lstm_fused(x_tbh, mask, w1)
+            xp2 = jnp.dot(h1, wx2,
+                          preferred_element_type=jnp.float32).astype(dt)
+            h2, _ = pk.lstm_fused(xp2, mask, w2)
+            return jnp.sum(h2.astype(jnp.float32) ** 2)
+        l, g = jax.value_and_grad(f, argnums=(0, 1, 2, 3))(
+            x_tbh, w1, wx2, w2)
+        return l, g
+
+    x_tbh = jnp.asarray(rng.randn(T, B, 4 * H) * 0.1, dt)
+    t_rec = timed(recurrence, x_tbh, w1, wx2, w2)
+
+    # A: the full step (embedding + proj + recurrence + head + CE),
+    # grads for all weights, SGD-style update (optimizer cost ~Adam's
+    # elementwise pass; exact optimizer choice is noise at this size)
+    @jax.jit
+    def full(params):
+        def loss_fn(p):
+            e = p["emb"][toks]                          # [B, T, E]
+            x = jnp.einsum("bte,ek->tbk", e.astype(dt), p["wx1"]).astype(dt)
+            h1, _ = pk.lstm_fused(x, mask, p["w1"])
+            xp2 = jnp.dot(h1, p["wx2"],
+                          preferred_element_type=jnp.float32).astype(dt)
+            h2, _ = pk.lstm_fused(xp2, mask, p["w2"])
+            logits = jnp.dot(h2[-1].astype(jnp.float32),
+                             p["wo"].astype(jnp.float32))
+            lse = jax.nn.logsumexp(logits, -1)
+            return jnp.mean(lse - logits[jnp.arange(B), labels])
+        l, g = jax.value_and_grad(loss_fn)(params)
+        return l, jax.tree.map(lambda p, gg: p - 1e-3 * gg.astype(p.dtype),
+                               params, g)
+
+    params = {"emb": emb, "wx1": wx1, "w1": w1, "wx2": wx2, "w2": w2,
+              "wo": wo}
+    t_full = timed(full, params)
+
+    # C: batched remainder (same graph, recurrence replaced by its
+    # input reshaped — isolates emb/proj/head/update cost)
+    @jax.jit
+    def batched_only(params):
+        def loss_fn(p):
+            e = p["emb"][toks]
+            x = jnp.einsum("bte,ek->tbk", e.astype(dt), p["wx1"]).astype(dt)
+            h2 = jnp.tanh(x[..., :H])   # stand-in, no recurrence
+            xp2 = jnp.dot(h2, p["wx2"],
+                          preferred_element_type=jnp.float32).astype(dt)
+            logits = jnp.dot(xp2[-1, :, :H].astype(jnp.float32),
+                             p["wo"].astype(jnp.float32))
+            lse = jax.nn.logsumexp(logits, -1)
+            return jnp.mean(lse - logits[jnp.arange(B), labels])
+        l, g = jax.value_and_grad(loss_fn)(params)
+        return l, jax.tree.map(lambda p, gg: p - 1e-3 * gg.astype(p.dtype),
+                               params, g)
+
+    t_batched = timed(batched_only, params)
+
+    grid_steps = 4 * T  # 2 layers x (fwd + bwd) kernels, grid=(T,)
+    print(f"full step:        {t_full*1e3:7.2f} ms "
+          f"({B*T/t_full/1e3:.0f}k tok/s)")
+    print(f"recurrence alone: {t_rec*1e3:7.2f} ms "
+          f"({100*t_rec/t_full:.0f}% of full)")
+    print(f"batched parts:    {t_batched*1e3:7.2f} ms")
+    print(f"per-grid-step latency ~ {t_rec/grid_steps*1e6:.1f} us "
+          f"({grid_steps} sequential kernel grid steps)")
+
+
+if __name__ == "__main__":
+    main()

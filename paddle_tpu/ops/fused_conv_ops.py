@@ -185,9 +185,16 @@ def _fused_fn(prologue: bool, prologue_relu: bool, interpret: bool):
         dy_c = (dy + ds.astype(dt) + (2.0 * dsq).astype(dt) * y).astype(dt)
         if prologue:
             g = pi * ps  # [Cin] f32
-            xh = x * g.astype(dt) + (pb - pm * g).astype(dt)
+            # recompute the prologue in f32, as the forward kernel does,
+            # so the ReLU mask `xh > 0` cannot disagree with the forward
+            # near zero (a bf16 recompute flips borderline signs and
+            # takes dx/dw at slightly different activations — ADVICE r4);
+            # XLA fuses this elementwise chain into its consumers, so no
+            # f32 [N, Cin] tensor is materialized to HBM
+            xh32 = x.astype(jnp.float32) * g + (pb - pm * g)
+            xh = xh32.astype(dt)
             if prologue_relu:
-                pos = xh > 0
+                pos = xh32 > 0
                 xn_c = jnp.where(pos, xh, jnp.zeros((), dt))
             else:
                 xn_c = xh
