@@ -34,6 +34,7 @@ from . import layers  # noqa: F401
 from . import networks  # noqa: F401
 from . import optimizer  # noqa: F401
 from . import regularizer  # noqa: F401
+from . import serving  # noqa: F401
 from .core import (  # noqa: F401
     CPUPlace,
     Executor,

@@ -6,25 +6,35 @@ the C library embeds CPython (the same trick the reference trainer uses
 for config parsing — TrainerConfigHelper.cpp:58 runs config_parser.py
 in an embedded interpreter) and drives this module. The C side only
 handles raw byte buffers; everything numpy stays here.
+
+Since the serving PR the Predictor delegates to
+serving.ServingEngine, so C-ABI traffic gets the same shape-bucketed
+compile cache as the HTTP front-end: a C client sweeping batch sizes
+compiles at most len(batch_buckets) XLA programs instead of one per
+novel batch size. Numerics are unchanged — padding replicates the last
+real row and the fetch is sliced back to the request's rows.
 """
 
 from __future__ import annotations
 
-from typing import Dict, List, Sequence
+from typing import Dict, Sequence
 
 import numpy as np
 
-from .core.executor import Executor, Scope
-from .io import load_inference_model
+from .serving.engine import BucketPolicy, ServingEngine
 
 
 class Predictor:
-    def __init__(self, model_dir: str):
-        self.scope = Scope()
-        self.program, self.feed_names, self.fetch_names = (
-            load_inference_model(model_dir, scope=self.scope)
-        )
-        self.exe = Executor()
+    def __init__(self, model_dir: str, max_batch_size: int = 256):
+        self.engine = ServingEngine(
+            model_dir, policy=BucketPolicy(max_batch_size=max_batch_size),
+            model_name="capi")
+        # compat aliases (pre-serving Predictor surface)
+        self.scope = self.engine.scope
+        self.program = self.engine.program
+        self.feed_names = self.engine.feed_names
+        self.fetch_names = self.engine.fetch_names
+        self.exe = self.engine.exe
 
     def num_fetch(self) -> int:
         return len(self.fetch_names)
@@ -44,13 +54,8 @@ class Predictor:
             feed[name] = np.frombuffer(blob, dtype=np.dtype(dt)).reshape(
                 tuple(shape)
             )
-        outs = self.exe.run(
-            self.program,
-            feed=feed,
-            fetch_list=[self.fetch_names[fetch_idx]],
-            scope=self.scope,
-        )
-        out = np.ascontiguousarray(np.asarray(outs[0]))
+        outs = self.engine.predict(feed)
+        out = np.ascontiguousarray(np.asarray(outs[fetch_idx]))
         return out.tobytes(), list(out.shape), out.dtype.name
 
 

@@ -20,6 +20,11 @@ Commands:
   train       --config M.py [--num_passes N] [--save_dir D] [flags...]
   merge_model --model_dir D --out O   (MergeModel.cpp parity: checkpoint
                                        params -> single deployable dir)
+  serve       --model_dir D [--model name=dir ...] [--host H] [--port P]
+              [--max_batch_size N] [--max_wait_ms M] [--max_queue Q]
+              [--timeout_ms T] [--seq_len_buckets 64,128,...] [--warmup 0|1]
+              batching HTTP inference server over saved inference
+              models (paddle_tpu.serving): /predict, /healthz, /metrics
   flags       print the flag registry
   version     print the version
 """
@@ -154,6 +159,86 @@ def _cmd_merge_model(argv) -> int:
     return 0
 
 
+def _parse_kv(argv, known):
+    """--k v / --k=v option parsing (list-valued keys may repeat)."""
+    opts: dict = {}
+    i = 0
+    while i < len(argv):
+        a = argv[i]
+        if not a.startswith("--"):
+            raise SystemExit(f"unexpected argument {a!r}")
+        name, eq, val = a.partition("=")
+        name = name[2:].replace("-", "_")
+        if name not in known:
+            raise SystemExit(f"unknown option --{name}")
+        if not eq:
+            if i + 1 >= len(argv):
+                raise SystemExit(f"option --{name} requires a value")
+            val = argv[i + 1]
+            i += 1
+        if known[name] is list:
+            opts.setdefault(name, []).append(val)
+        else:
+            opts[name] = val
+        i += 1
+    return opts
+
+
+def _cmd_serve(argv) -> int:
+    """Batching inference server over saved inference models."""
+    from .serving import BucketPolicy, ModelRegistry, make_server
+
+    known = {
+        "model_dir": str, "model": list, "host": str, "port": str,
+        "max_batch_size": str, "max_wait_ms": str, "max_queue": str,
+        "timeout_ms": str, "seq_len_buckets": str, "warmup": str,
+    }
+    opts = _parse_kv(argv, known)
+    models = {}
+    if "model_dir" in opts:
+        models["default"] = opts["model_dir"]
+    for spec in opts.get("model", []):
+        name, eq, d = spec.partition("=")
+        if not eq:
+            raise SystemExit(
+                f"--model needs name=dir, got {spec!r}")
+        models[name] = d
+    if not models:
+        raise SystemExit("serve requires --model_dir <dir> or at least "
+                         "one --model name=dir")
+    policy = BucketPolicy(
+        max_batch_size=int(opts.get("max_batch_size", 64)),
+        seq_len_buckets=tuple(
+            int(t) for t in opts.get("seq_len_buckets", "").split(",")
+            if t.strip()),
+    )
+    registry = ModelRegistry()
+    for name, d in models.items():
+        engine, _ = registry.add(
+            name, model_dir=d, policy=policy,
+            max_wait_ms=float(opts.get("max_wait_ms", 5.0)),
+            max_queue=int(opts.get("max_queue", 256)),
+            timeout_ms=float(opts.get("timeout_ms", 2000.0)),
+        )
+        if opts.get("warmup", "1") not in ("0", "false", "no"):
+            n = engine.warmup()
+            print(f"model {name!r}: warmed {n} bucket programs",
+                  flush=True)
+    server = make_server(registry, host=opts.get("host", "127.0.0.1"),
+                         port=int(opts.get("port", 8866)))
+    registry.start()
+    print(f"serving {registry.names()} on "
+          f"http://{server.server_address[0]}:{server.port}", flush=True)
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        registry.stop()
+        server.server_close()
+    return 0
+
+
 def main(argv=None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     if not argv or argv[0] in ("-h", "--help", "help"):
@@ -164,6 +249,8 @@ def main(argv=None) -> int:
         return _cmd_train(rest)
     if cmd == "merge_model":
         return _cmd_merge_model(rest)
+    if cmd == "serve":
+        return _cmd_serve(rest)
     if cmd == "flags":
         print(flags_help())
         return 0
@@ -173,7 +260,7 @@ def main(argv=None) -> int:
         print(full_version)
         return 0
     raise SystemExit(f"unknown command {cmd!r}; try: train, merge_model, "
-                     "flags, version")
+                     "serve, flags, version")
 
 
 if __name__ == "__main__":

@@ -20,6 +20,7 @@ XLA CSEs the duplicated forward, so this costs nothing at runtime.
 
 from __future__ import annotations
 
+import os
 from typing import Any, Dict, List, Optional, Sequence
 
 import jax
@@ -281,6 +282,13 @@ class Executor:
         # the old arrays outside the Scope.
         self.donate_state = donate_state
         self._cache: Dict[Any, Any] = {}
+        # jit-cache accounting (the serving layer surfaces these in
+        # /metrics): a miss = one whole-program trace + XLA compile
+        self.cache_stats: Dict[str, int] = {"hits": 0, "misses": 0}
+
+    def cache_size(self) -> int:
+        """Number of compiled (program, feed-signature) entries held."""
+        return len(self._cache)
 
     # -- subclass hooks (ParallelExecutor overrides these) -------------
     def _cache_key_prefix(self) -> tuple:
@@ -345,17 +353,23 @@ class Executor:
             FLAGS.fused_conv_interpret,
             FLAGS.fused_conv_dot_max_n,
             FLAGS.stacked_lstm_single_scan,
+            # trace-affecting env override read in bahdanau _bblk: a
+            # tuning sweep flipping it on a live Executor must re-trace,
+            # not silently reuse the stale tile choice
+            os.environ.get("PT_ATTN_BBLK", ""),
             _feed_signature(feed),
             tuple(fetch_names),
             tuple(persist_names),
         )
         cached = self._cache.get(key)
         if cached is None:
+            self.cache_stats["misses"] += 1
             fn = self._compile(program, feed, fetch_names, persist_names)
             # keep a strong ref to the program: the key uses id(program),
             # which may be recycled if the program were garbage collected
             self._cache[key] = (program, fn)
         else:
+            self.cache_stats["hits"] += 1
             fn = cached[1]
 
         state = {n: scope.get(n) for n in persist_names}

@@ -209,6 +209,17 @@ def save_inference_model(
         if v.persistable and scope.has(v.name)
     )
     save_vars(dirname, param_names, scope)
+    # feed dtypes/shapes travel with the artifact so a serving front-end
+    # can coerce JSON inputs (int32 ids vs float32 features) without
+    # reconstructing them from the program graph
+    feed_specs = {}
+    for n in feeded_var_names:
+        try:
+            v = pruned.global_block().var(n)
+            feed_specs[n] = {"dtype": np.dtype(v.dtype).name,
+                             "shape": [int(d) for d in v.shape]}
+        except KeyError:
+            pass
     with open(os.path.join(dirname, PROGRAM_FILE), "w") as f:
         json.dump(pruned.to_dict(), f)
     with open(os.path.join(dirname, META_FILE), "w") as f:
@@ -217,6 +228,7 @@ def save_inference_model(
                 "feed_names": list(feeded_var_names),
                 "fetch_names": target_names,
                 "param_names": param_names,
+                "feed_specs": feed_specs,
             },
             f,
         )
@@ -231,6 +243,9 @@ def load_inference_model(dirname: str, scope: Optional[Scope] = None):
     with open(os.path.join(dirname, META_FILE)) as f:
         meta = json.load(f)
     load_vars(dirname, scope, var_names=meta["param_names"])
+    # serving sidecar (absent in pre-serving artifacts): per-feed
+    # dtype/shape specs, consumed by serving.ServingEngine
+    program._serving_meta = meta.get("feed_specs") or None
     return program, meta["feed_names"], meta["fetch_names"]
 
 

@@ -605,11 +605,12 @@ def _decoder_seq_bwd(ep, enc, maskf, g_seq, tmask, hp_seq, u_seq, r_seq,
       dp_seq, alpha_seq, v.reshape(1, -1), w_c, w_ur, wx_c, wa_dec)
 
 
-def _mega_bwd_vmem_ok(B, Sp, A, C, H, itemsize) -> bool:
+def _mega_bwd_vmem_ok(B, Sp, A, C, H, T, itemsize) -> bool:
     """Whole-sequence backward kernel working set: resident ep/enc tiles
     + resident weights + f32 scratch (dh, dep accumulator, dv) + f32
     tanh/omt2/dep-term temporaries + double-buffered per-step streams
-    and output blocks + the once-written dep/dh0 output blocks."""
+    and output blocks + the resident [blk, Tp] f32 tmask tile + the
+    once-written dep/dh0 output blocks."""
     blk = _bblk(B, Sp, A, C, itemsize)
     if blk == 0:
         return False
@@ -617,12 +618,16 @@ def _mega_bwd_vmem_ok(B, Sp, A, C, H, itemsize) -> bool:
     weights = (H * H + 2 * H * H + C * 3 * H + H * A + A) * itemsize
     scratch = (blk * H + blk * Sp * A + A) * 4
     temps = 3 * blk * Sp * A * 4
-    # alpha streams at f32 regardless of io dtype; the resident [blk,Tp]
-    # tmask tile (~blk*T*4, T unknown here) is noise next to these terms
+    # alpha streams at f32 regardless of io dtype
     streams = 2 * blk * ((5 * H + A + 1) * itemsize + Sp * 4)
+    # the [blk, Tp] tmask tile stays resident across the whole T walk
+    # (f32, T padded to a sublane multiple); small at bench T but a
+    # long-T config must not pass the model and then fail Mosaic's
+    # VMEM allocation at compile time
+    tmask = blk * (((T + 7) // 8) * 8) * 4
     outs = 2 * blk * (3 * H + C + A) * itemsize \
         + blk * Sp * A * itemsize + blk * H * itemsize + A * 4
-    return tiles + weights + scratch + temps + streams + outs \
+    return tiles + weights + scratch + temps + streams + tmask + outs \
         <= _VMEM_BUDGET
 
 
@@ -724,7 +729,7 @@ def _decoder_fn(interpret: bool, axis=None):
             xp_seq[..., 2 * H:] + jnp.dot(rh_seq, w_c).astype(dt))
 
         if FLAGS.fused_attention_seq_bwd and _mega_bwd_vmem_ok(
-                B, ep.shape[1], ep.shape[-1], enc.shape[-1], H,
+                B, ep.shape[1], ep.shape[-1], enc.shape[-1], H, T,
                 ep.dtype.itemsize):
             # whole-sequence backward kernel: the reverse dh chain, the
             # per-step attention backward, AND the phase-2 dep/dv

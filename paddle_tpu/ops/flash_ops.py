@@ -73,14 +73,24 @@ _SCORE_BYTES_THRESHOLD = 1.5e9
 def _prefers_flash(q, k) -> bool:
     import numpy as np
 
+    from . import mesh_dispatch
+
     B, Tq, H, _ = q.shape
     Tk = k.shape[1]
     if Tq >= _FLASH_MIN_T and Tk >= _FLASH_MIN_T:
         return True  # measured-win regime with tuned blocks
+    # the shard_map'd kernel runs at the PER-SHARD batch (B/dp under a
+    # mesh), so the score-buffer rule must see that batch too — same
+    # eligibility discipline as the decoder/RNN kernels. local_batch
+    # returns 0 when dp does not divide B; flash_attention falls back
+    # to the XLA formulation for that case anyway.
+    Bl = mesh_dispatch.local_batch(B)
+    if Bl == 0:
+        return False
     # scores inherit the input dtype in the reference formulation: f32
     # inputs double the buffer vs bf16
     itemsize = np.dtype(q.dtype).itemsize
-    return B * H * Tq * Tk * itemsize > _SCORE_BYTES_THRESHOLD
+    return Bl * H * Tq * Tk * itemsize > _SCORE_BYTES_THRESHOLD
 
 
 def flash_eligible(q, k=None) -> bool:

@@ -80,6 +80,15 @@ class StatSet:
         print(out)
         return out
 
+    def as_dict(self) -> Dict[str, Dict[str, float]]:
+        """Point-in-time snapshot for programmatic export (the serving
+        /metrics endpoint renders this in Prometheus text format)."""
+        return {
+            name: {"count": s.count, "total": s.total,
+                   "avg": s.avg, "max": s.max}
+            for name, s in self.stats.items()
+        }
+
     def reset(self) -> None:
         self.stats.clear()
 

@@ -1,0 +1,39 @@
+"""paddle_tpu.serving: batching inference server with a shape-bucketed
+compile cache.
+
+The inference-serving surface of the rebuild (reference: paddle/capi,
+the pure-C inference ABI — extended here to concurrent traffic, which
+a jitted-XLA engine only survives by keeping the compiled-program set
+bounded). Layers, bottom-up:
+
+- `engine`  — ServingEngine: pads requests into shape buckets so all
+              traffic hits at most len(buckets) XLA programs, with
+              hit/miss accounting.
+- `batcher` — MicroBatcher: coalesces concurrent requests into one
+              padded batch (queue + max_batch_size + max_wait_ms),
+              with bounded depth, deadlines, and load shedding.
+- `server`  — ModelRegistry + threaded stdlib-HTTP JSON front-end
+              (/predict, /healthz, /stats, /metrics).
+- `metrics` — latency/batch histograms + Prometheus text export over
+              the existing profiler.StatSet plumbing.
+
+CLI: `python -m paddle_tpu serve --model_dir <saved_inference_model>`.
+"""
+
+from .engine import BucketPolicy, ServingEngine  # noqa: F401
+from .batcher import DeadlineError, MicroBatcher, ShedError  # noqa: F401
+from .metrics import Histogram, MetricSet  # noqa: F401
+from .server import ModelRegistry, ServingServer, make_server  # noqa: F401
+
+__all__ = [
+    "BucketPolicy",
+    "ServingEngine",
+    "MicroBatcher",
+    "ShedError",
+    "DeadlineError",
+    "MetricSet",
+    "Histogram",
+    "ModelRegistry",
+    "ServingServer",
+    "make_server",
+]

@@ -1,0 +1,272 @@
+"""Dynamic micro-batcher: coalesce concurrent requests into one call.
+
+The throughput physics: one padded batch through the jitted program
+costs nearly the same device time as one row (the MXU is idle at
+serving batch sizes), so K concurrent single-row requests served as
+one batch of K cost ~1/K the per-request device time. The reference
+framework never had this layer — paddle/capi is strictly
+one-request-per-forward — but its multi-threaded trainer gradient
+merge (TrainerInternal.cpp) is the same shape: N producers, one
+consumer that folds their work into a single device call.
+
+Design (queue + window, the standard dynamic-batching contract):
+- `submit()` appends to a BOUNDED deque and returns a Future. A full
+  queue sheds load immediately (`ShedError`, HTTP 503) instead of
+  letting latency collapse into an unbounded backlog.
+- One worker thread takes the oldest request, opens a window of
+  `max_wait_ms`, and coalesces every compatible request (same
+  non-batch feed signature) that arrives inside the window, up to
+  `max_batch_size` total rows. Incompatible requests stay queued for
+  the next round — heterogeneous-shape traffic degrades to smaller
+  batches, never to wrong answers.
+- Each request carries a deadline (`timeout_ms` from submit time).
+  Requests found expired at dispatch time fail with `DeadlineError`
+  (HTTP 504) without touching the device; a request that expires
+  mid-run still gets its (late) result, matching the usual "deadline
+  checked at dequeue" serving semantics.
+- Results scatter back by row offsets; an engine exception fans out to
+  every request in the batch.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from concurrent.futures import Future
+from concurrent.futures import TimeoutError as FuturesTimeout
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from .engine import ServingEngine
+from .metrics import MetricSet
+
+__all__ = ["MicroBatcher", "ShedError", "DeadlineError"]
+
+
+class ShedError(RuntimeError):
+    """Queue at capacity: the request was rejected, not enqueued."""
+
+
+class DeadlineError(RuntimeError):
+    """The request's deadline passed before dispatch."""
+
+
+class _Request:
+    __slots__ = ("feed", "rows", "future", "deadline", "signature")
+
+    def __init__(self, feed: Dict[str, np.ndarray], deadline: float):
+        self.feed = feed
+        rows = {v.shape[0] for v in feed.values() if v.ndim >= 1}
+        if len(rows) != 1:
+            raise ValueError(
+                f"batchable feeds must share the batch axis; got row "
+                f"counts {sorted(rows)}")
+        self.rows = rows.pop()
+        self.future: Future = Future()
+        self.deadline = deadline
+        # requests concat only when every non-batch extent and dtype
+        # matches (same compiled bucket after padding)
+        self.signature = tuple(
+            (k, feed[k].shape[1:], feed[k].dtype.name)
+            for k in sorted(feed))
+
+
+class MicroBatcher:
+    def __init__(
+        self,
+        engine: ServingEngine,
+        max_batch_size: Optional[int] = None,
+        max_wait_ms: float = 5.0,
+        max_queue: int = 256,
+        timeout_ms: float = 2000.0,
+        metrics: Optional[MetricSet] = None,
+    ):
+        self.engine = engine
+        self.max_batch_size = (max_batch_size
+                               or engine.policy.max_batch_size)
+        self.max_wait_s = max_wait_ms / 1e3
+        self.max_queue = max_queue
+        self.timeout_s = timeout_ms / 1e3
+        self.metrics = metrics or engine.metrics
+        self._q: collections.deque = collections.deque()
+        self._cond = threading.Condition()
+        self._worker: Optional[threading.Thread] = None
+        self._stopping = False
+        self._batch_hist = self.metrics.histogram(
+            "batch_rows", buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256),
+            help="rows per coalesced engine call")
+        self.metrics.gauge(
+            "queue_depth", lambda: len(self._q),
+            help="requests waiting for dispatch")
+
+    # -- lifecycle ------------------------------------------------------
+    def start(self) -> "MicroBatcher":
+        with self._cond:
+            if self._worker is not None and self._worker.is_alive():
+                return self
+            self._stopping = False
+            self._worker = threading.Thread(
+                target=self._run, name=f"ptserving-{self.engine.model_name}",
+                daemon=True)
+            self._worker.start()
+        return self
+
+    def stop(self, drain: bool = False) -> None:
+        """Stop the worker. drain=True lets queued work finish first;
+        otherwise queued requests fail with ShedError."""
+        with self._cond:
+            if drain:
+                while self._q and self._worker and self._worker.is_alive():
+                    self._cond.wait(timeout=0.05)
+            self._stopping = True
+            if not drain:
+                while self._q:
+                    req = self._q.popleft()
+                    req.future.set_exception(
+                        ShedError("batcher shutting down"))
+            self._cond.notify_all()
+        if self._worker is not None:
+            self._worker.join(timeout=5.0)
+
+    # -- client side ----------------------------------------------------
+    def submit(self, feed: Dict[str, np.ndarray],
+               timeout_ms: Optional[float] = None) -> Future:
+        req = _Request(
+            feed,
+            time.monotonic() + (timeout_ms / 1e3 if timeout_ms is not None
+                                else self.timeout_s))
+        if req.rows > self.max_batch_size:
+            raise ValueError(
+                f"request rows {req.rows} exceed max_batch_size "
+                f"{self.max_batch_size}")
+        with self._cond:
+            if self._stopping:
+                raise ShedError("batcher stopped")
+            if len(self._q) >= self.max_queue:
+                self.metrics.counter_inc(
+                    "shed_total",
+                    help="requests rejected because the queue was full")
+                raise ShedError(
+                    f"queue full ({self.max_queue} waiting); retry later")
+            self._q.append(req)
+            self._cond.notify()
+        return req.future
+
+    def predict(self, feed: Dict[str, np.ndarray],
+                timeout_ms: Optional[float] = None) -> List[np.ndarray]:
+        """submit + wait. Raises ShedError / DeadlineError / the
+        engine's exception. The wait allows the deadline plus an equal
+        grace (min 1 s) for a dispatch already in flight — a cold
+        bucket compile on the first request may exceed the deadline
+        alone; warm the engine (ServingEngine.warmup) to avoid
+        first-request 504s."""
+        fut = self.submit(feed, timeout_ms=timeout_ms)
+        budget = (timeout_ms / 1e3 if timeout_ms is not None
+                  else self.timeout_s)
+        try:
+            return fut.result(timeout=budget + max(1.0, budget))
+        except FuturesTimeout:
+            self.metrics.counter_inc(
+                "deadline_exceeded_total",
+                help="requests that expired before dispatch")
+            raise DeadlineError(
+                "deadline exceeded waiting for a result") from None
+
+    # -- worker side ----------------------------------------------------
+    def _take_batch(self) -> List[_Request]:
+        """Block for the first request, then coalesce compatible ones
+        inside the wait window. Returns [] only when stopping."""
+        with self._cond:
+            while not self._q and not self._stopping:
+                self._cond.wait()
+            if self._stopping and not self._q:
+                return []
+            first = self._q.popleft()
+            now = time.monotonic()
+            if first.deadline <= now:
+                first.future.set_exception(DeadlineError(
+                    "deadline exceeded while queued"))
+                self.metrics.counter_inc(
+                    "deadline_exceeded_total",
+                    help="requests that expired before dispatch")
+                return self._NOTHING
+            batch = [first]
+            rows = first.rows
+            window_end = now + self.max_wait_s
+            while rows < self.max_batch_size:
+                # scan the queue for compatible requests; leave others
+                picked = None
+                for i, req in enumerate(self._q):
+                    if req.deadline <= time.monotonic():
+                        del self._q[i]
+                        req.future.set_exception(DeadlineError(
+                            "deadline exceeded while queued"))
+                        self.metrics.counter_inc(
+                            "deadline_exceeded_total",
+                            help="requests that expired before dispatch")
+                        picked = self._RESCAN
+                        break
+                    if (req.signature == first.signature
+                            and rows + req.rows <= self.max_batch_size):
+                        del self._q[i]
+                        picked = req
+                        break
+                if picked is self._RESCAN:
+                    continue
+                if picked is not None:
+                    batch.append(picked)
+                    rows += picked.rows
+                    continue
+                remaining = window_end - time.monotonic()
+                if remaining <= 0 or self._stopping:
+                    break
+                self._cond.wait(timeout=remaining)
+            return batch
+
+    _RESCAN = object()
+    _NOTHING: List[_Request] = []
+
+    def _run(self) -> None:
+        while True:
+            batch = self._take_batch()
+            if not batch:
+                with self._cond:
+                    if self._stopping and not self._q:
+                        self._cond.notify_all()
+                        return
+                continue
+            self._dispatch(batch)
+            with self._cond:
+                self._cond.notify_all()  # wake stop(drain=True) waiters
+
+    def _dispatch(self, batch: List[_Request]) -> None:
+        try:
+            if len(batch) == 1:
+                feed = batch[0].feed
+            else:
+                feed = {
+                    k: np.concatenate([r.feed[k] for r in batch], axis=0)
+                    for k in batch[0].feed
+                }
+            total = sum(r.rows for r in batch)
+            self._batch_hist.observe(total)
+            self.metrics.counter_inc(
+                "requests_total", by=len(batch),
+                help="requests dispatched to the engine")
+            outs = self.engine.predict(feed)
+            off = 0
+            for r in batch:
+                sliced = [
+                    o[off:off + r.rows]
+                    if (hasattr(o, "ndim") and o.ndim >= 1
+                        and o.shape[0] == total) else o
+                    for o in outs
+                ]
+                off += r.rows
+                r.future.set_result(sliced)
+        except Exception as e:  # fan the failure out, keep serving
+            for r in batch:
+                if not r.future.done():
+                    r.future.set_exception(e)

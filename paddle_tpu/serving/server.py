@@ -1,0 +1,198 @@
+"""Threaded stdlib-HTTP JSON front-end over the serving engine.
+
+Reference: paddle/capi's examples embed the inference runtime into a
+user process; the rebuild's north star ("serves heavy traffic from
+millions of users", ROADMAP.md) needs a network surface. This is a
+deliberately dependency-free one: `http.server.ThreadingHTTPServer`
+(one thread per connection — fine, because every request ends up
+waiting on the SAME micro-batcher, which is where the concurrency
+actually folds into device calls) speaking JSON.
+
+Endpoints:
+  POST /predict            single-model deployments (model "default")
+  POST /predict/<model>    multi-model registry routing
+       body: {"inputs": {feed_name: nested list}, "timeout_ms": opt}
+       reply: {"outputs": {fetch_name: nested list}, "model": name}
+  GET  /healthz            {"status": "ok", "models": [...]}
+  GET  /stats              per-model engine/bucket/cache accounting
+  GET  /metrics            Prometheus text (latency histograms,
+                           batch-size histogram, queue depth, cache
+                           hit/miss counters, shed/deadline counters)
+
+Status mapping: 400 malformed request, 404 unknown model/route,
+503 load shed (queue full; includes Retry-After), 504 deadline
+exceeded, 500 engine failure.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from .. import profiler
+from .batcher import DeadlineError, MicroBatcher, ShedError
+from .engine import BucketPolicy, ServingEngine
+from .metrics import MetricSet
+
+__all__ = ["ModelRegistry", "ServingServer", "make_server"]
+
+
+class ModelRegistry:
+    """name → (engine, batcher). One shared MetricSet across models so
+    /metrics is a single scrape."""
+
+    def __init__(self, metrics: Optional[MetricSet] = None):
+        self.metrics = metrics or MetricSet(
+            stat_set=profiler.global_stat_set())
+        self._models: Dict[str, Tuple[ServingEngine, MicroBatcher]] = {}
+
+    def add(
+        self,
+        name: str,
+        model_dir: Optional[str] = None,
+        engine: Optional[ServingEngine] = None,
+        batcher: Optional[MicroBatcher] = None,
+        policy: Optional[BucketPolicy] = None,
+        **batcher_kw,
+    ) -> Tuple[ServingEngine, MicroBatcher]:
+        if engine is None:
+            if model_dir is None:
+                raise ValueError("add() needs model_dir or engine")
+            engine = ServingEngine(model_dir, policy=policy,
+                                   model_name=name, metrics=self.metrics)
+        if batcher is None:
+            batcher = MicroBatcher(engine, metrics=self.metrics,
+                                   **batcher_kw)
+        self._models[name] = (engine, batcher)
+        return engine, batcher
+
+    def get(self, name: str) -> Tuple[ServingEngine, MicroBatcher]:
+        return self._models[name]
+
+    def names(self):
+        return sorted(self._models)
+
+    def start(self) -> "ModelRegistry":
+        for _, b in self._models.values():
+            b.start()
+        return self
+
+    def stop(self) -> None:
+        for _, b in self._models.values():
+            b.stop()
+
+    def stats(self) -> Dict[str, dict]:
+        return {n: e.stats() for n, (e, _) in self._models.items()}
+
+
+class _Handler(BaseHTTPRequestHandler):
+    # the registry/metrics hang off the server instance (stdlib idiom)
+    server: "ServingServer"
+    protocol_version = "HTTP/1.1"
+
+    # -- helpers --------------------------------------------------------
+    def _send(self, code: int, payload, content_type="application/json",
+              extra_headers=()):
+        body = (payload if isinstance(payload, bytes)
+                else json.dumps(payload).encode())
+        self.send_response(code)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(body)))
+        for k, v in extra_headers:
+            self.send_header(k, v)
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _error(self, code: int, message: str, **extra):
+        self._send(code, {"error": message, **extra},
+                   extra_headers=(
+                       (("Retry-After", "1"),) if code == 503 else ()))
+
+    def log_message(self, fmt, *args):  # quiet by default
+        pass
+
+    # -- routes ---------------------------------------------------------
+    def do_GET(self):
+        reg = self.server.registry
+        if self.path == "/healthz":
+            self._send(200, {"status": "ok", "models": reg.names()})
+        elif self.path == "/metrics":
+            self._send(200, reg.metrics.render().encode(),
+                       content_type="text/plain; version=0.0.4")
+        elif self.path == "/stats":
+            self._send(200, reg.stats())
+        else:
+            self._error(404, f"no route {self.path!r}")
+
+    def do_POST(self):
+        if self.path == "/predict":
+            name = "default"
+        elif self.path.startswith("/predict/"):
+            name = self.path[len("/predict/"):]
+        else:
+            self._error(404, f"no route {self.path!r}")
+            return
+        reg = self.server.registry
+        try:
+            engine, batcher = reg.get(name)
+        except KeyError:
+            self._error(404, f"unknown model {name!r}; have {reg.names()}")
+            return
+        try:
+            length = int(self.headers.get("Content-Length", 0))
+            req = json.loads(self.rfile.read(length) or b"{}")
+            inputs = req["inputs"]
+            feed = engine.coerce_feed(inputs)
+        except (ValueError, KeyError, TypeError) as e:
+            self._error(400, f"bad request: {e}")
+            return
+        try:
+            outs = batcher.predict(
+                feed, timeout_ms=req.get("timeout_ms"))
+        except ShedError as e:
+            self._error(503, str(e))
+            return
+        except DeadlineError as e:
+            self._error(504, str(e))
+            return
+        except Exception as e:  # model/engine failure
+            self._error(500, f"{type(e).__name__}: {e}")
+            return
+        self._send(200, {
+            "model": name,
+            "outputs": {
+                fn: np.asarray(o).tolist()
+                for fn, o in zip(engine.fetch_names, outs)
+            },
+        })
+
+
+class ServingServer(ThreadingHTTPServer):
+    daemon_threads = True
+
+    def __init__(self, addr, registry: ModelRegistry):
+        super().__init__(addr, _Handler)
+        self.registry = registry
+
+    @property
+    def port(self) -> int:
+        return self.server_address[1]
+
+    def serve_background(self) -> threading.Thread:
+        """Start batchers + a daemon serve_forever thread (tests and
+        embedders); `shutdown()` + `registry.stop()` to tear down."""
+        self.registry.start()
+        t = threading.Thread(target=self.serve_forever,
+                             name="ptserving-http", daemon=True)
+        t.start()
+        return t
+
+
+def make_server(registry: ModelRegistry, host: str = "127.0.0.1",
+                port: int = 0) -> ServingServer:
+    """Bind (port 0 = OS-assigned; read `server.port`)."""
+    return ServingServer((host, port), registry)

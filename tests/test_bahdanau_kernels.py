@@ -213,7 +213,7 @@ def test_bench_geometry_engages_fused_path(interpret_flag):
     # the flag a no-op silently)
     from paddle_tpu.ops.bahdanau_kernels import (_mega_bwd_vmem_ok,
                                                  _pad_s)
-    assert _mega_bwd_vmem_ok(256, _pad_s(50), 512, 1024, 512, 2)
+    assert _mega_bwd_vmem_ok(256, _pad_s(50), 512, 1024, 512, 50, 2)
     # and the fused path actually DISPATCHES at the bench geometry, not
     # just passes the predicate: trace the decoder fwd+bwd at the real
     # shapes (jax.eval_shape — abstract, no FLOPs) and assert the

@@ -141,3 +141,69 @@ def test_cli_train_checkpoint_merge_infer_roundtrip(tmp_path):
                       feed={feed_names[0]: np.ones((2, 4), np.float32)},
                       fetch_list=fetch_names)
     assert pred.shape == (2, 1) and np.all(np.isfinite(pred))
+
+
+def test_cli_serve_end_to_end(tmp_path):
+    """`serve` boots the batching HTTP server over a saved inference
+    model: /healthz answers, /predict matches the in-process engine,
+    /metrics exposes the cache counters."""
+    import json
+    import subprocess as sp
+    import threading
+    import urllib.request
+
+    import paddle_tpu as pt
+
+    pt.reset()
+    x = pt.layers.data("x", shape=[4])
+    pred = pt.layers.fc(x, size=2)
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    model_dir = str(tmp_path / "model")
+    pt.io.save_inference_model(model_dir, ["x"], [pred])
+    xv = np.ones((3, 4), np.float32)
+    want = pt.serving.ServingEngine(model_dir).predict(
+        {"x": xv}, bucketed=False)[0]
+
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = sp.Popen(
+        [sys.executable, "-m", "paddle_tpu", "serve", "--model_dir",
+         model_dir, "--port", "0", "--max_batch_size", "8"],
+        stdout=sp.PIPE, stderr=sp.PIPE, text=True, env=env)
+    lines = []
+    reader = threading.Thread(
+        target=lambda: [lines.append(ln) for ln in proc.stdout],
+        daemon=True)
+    reader.start()
+    try:
+        deadline = __import__("time").monotonic() + 120
+        port = None
+        while __import__("time").monotonic() < deadline:
+            for ln in list(lines):
+                if ln.startswith("serving "):
+                    port = int(ln.rsplit(":", 1)[1])
+                    break
+            if port or proc.poll() is not None:
+                break
+            __import__("time").sleep(0.2)
+        assert port, (lines, proc.stderr.read() if proc.poll() is not None
+                      else "server did not announce a port")
+        url = f"http://127.0.0.1:{port}"
+        with urllib.request.urlopen(url + "/healthz", timeout=30) as r:
+            assert json.load(r)["status"] == "ok"
+        body = json.dumps({"inputs": {"x": xv.tolist()}}).encode()
+        req = urllib.request.Request(
+            url + "/predict", data=body,
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=60) as r:
+            out = json.load(r)
+        (vals,) = out["outputs"].values()
+        np.testing.assert_allclose(np.asarray(vals, np.float32), want,
+                                   rtol=1e-5, atol=1e-6)
+        with urllib.request.urlopen(url + "/metrics", timeout=30) as r:
+            assert "ptserving_compile_cache" in r.read().decode()
+    finally:
+        proc.terminate()
+        proc.wait(timeout=30)

@@ -454,6 +454,17 @@ def build_stacked_lstm2():
 
 
 @case
+def build_stacked_lstm():
+    # both outputs (last inter-layer fc sequence + last hidden sequence)
+    # feed the loss so every weight of the stack gets a grad path
+    emb, feed = _pre_seq(lens=(4, 2), d=8)
+    fc_out, h = L.stacked_lstm(emb, size=8, stacked_num=2, max_len=8)
+    cat = L.concat([L.sequence_last_step(fc_out),
+                    L.sequence_last_step(h)], axis=1)
+    return _scalar(cat), feed
+
+
+@case
 def build_fused_conv_bn():
     # raw-stats fused conv protocol, no-prologue unit + normalize
     x = L.data("x", shape=[4, 4, 6])
