@@ -25,6 +25,17 @@ Commands:
               [--timeout_ms T] [--seq_len_buckets 64,128,...] [--warmup 0|1]
               batching HTTP inference server over saved inference
               models (paddle_tpu.serving): /predict, /healthz, /metrics
+  tune        --kernel K --shape k=v,k=v [--shape ...] [--dtype bf16|f32]
+              [--dry-run] [--cache PATH] [--iters N] [--warmup N]
+              | --config M.py [--dry-run ...]
+              empirical kernel autotuner (paddle_tpu.tune): sweep legal
+              configs for a named kernel family over a shape grid (or
+              every tunable site of a model config), write the winners
+              to the persistent per-device table, print a before/after
+              report. --dry-run lists candidates without timing (works
+              on any backend; real timing requires TPU).
+              Kernels: bahdanau (B,S,A,C), flash (Tq,Tk), conv
+              (n,cin,cout), lstm/gru (B,H).
   flags       print the flag registry
   version     print the version
 """
@@ -239,6 +250,130 @@ def _cmd_serve(argv) -> int:
     return 0
 
 
+_DTYPE_ALIASES = {"bf16": "bfloat16", "bfloat16": "bfloat16",
+                  "f32": "float32", "fp32": "float32",
+                  "float32": "float32"}
+
+
+def _fmt_cfg(cfg) -> str:
+    if cfg is None:
+        return "<none>"
+    return ",".join(f"{k}={v}" for k, v in sorted(cfg.items()))
+
+
+def _cmd_tune(argv) -> int:
+    """Empirical kernel autotuner front-end (paddle_tpu.tune)."""
+    from .tune import cache as tune_cache
+    from .tune import harness, space
+
+    dry = False
+    rest = []
+    for a in argv:
+        if a in ("--dry-run", "--dry_run"):
+            dry = True
+        else:
+            rest.append(a)
+    known = {"kernel": str, "shape": list, "dtype": str, "cache": str,
+             "iters": str, "warmup": str, "config": str}
+    opts = _parse_kv(rest, known)
+    dtype = _DTYPE_ALIASES.get(opts.get("dtype", "bf16"))
+    if dtype is None:
+        raise SystemExit(f"--dtype must be bf16 or f32, got "
+                         f"{opts['dtype']!r}")
+
+    cases = []
+    if "config" in opts:
+        # model sweep: build the model's program, scan it for tunable
+        # kernel sites with concrete shapes
+        _load_config(opts["config"])
+        sites = space.cases_from_program()
+        if not sites:
+            print("no tunable kernel sites with concrete shapes found "
+                  "in the model program")
+        cases.extend(
+            {"family": s["family"], "params": s["params"],
+             "dtype": s["dtype"]} for s in sites)
+    if "kernel" in opts:
+        shapes = opts.get("shape", [])
+        if not shapes:
+            raise SystemExit("tune --kernel requires at least one "
+                             "--shape k=v,k=v (e.g. --shape "
+                             "B=256,S=60,A=512,C=512)")
+        try:
+            fam = space.get_family(opts["kernel"])
+        except KeyError as e:
+            raise SystemExit(str(e)) from None
+        for spec in shapes:
+            try:
+                params = {k: int(v) for k, _, v in
+                          (kv.partition("=") for kv in spec.split(","))}
+            except ValueError:
+                raise SystemExit(
+                    f"bad --shape {spec!r}: expected k=v,k=v with "
+                    "integer values") from None
+            # user-facing bahdanau shapes take the raw source length S;
+            # the kernels run over S padded (the signature's Sp)
+            if fam.name == "bahdanau_attention" and "S" in params \
+                    and "Sp" not in params:
+                params["Sp"] = space.pad_s(params.pop("S"))
+            cases.append({"family": fam.name, "params": params,
+                          "dtype": dtype})
+    if not cases:
+        raise SystemExit("tune requires --kernel <family> --shape ... "
+                         "and/or --config <model.py>")
+
+    if dry:
+        for c in cases:
+            try:
+                info = harness.list_candidates(c["family"], c["params"],
+                                               c["dtype"])
+            except (ValueError, KeyError) as e:
+                print(f"{c['family']}: {e}")
+                continue
+            sig = tune_cache.make_sig(info["params"])
+            print(f"kernel {info['kernel']}  {sig}  dtype={c['dtype']}")
+            print(f"  analytic default: {_fmt_cfg(info['default'])}")
+            print(f"  {len(info['candidates'])} legal candidates:")
+            for cfg in info["candidates"]:
+                mark = "   (analytic default)" \
+                    if cfg == info["default"] else ""
+                print(f"    {_fmt_cfg(cfg)}{mark}")
+        return 0
+
+    try:
+        harness.ensure_timeable()
+    except harness.TuningUnavailable as e:
+        raise SystemExit(str(e)) from None
+    path = opts.get("cache") or tune_cache.default_path()
+    table = tune_cache.TunedTable(path)  # merge into any existing table
+    iters = int(opts.get("iters", 7))
+    warmup = int(opts.get("warmup", 2))
+    for c in cases:
+        try:
+            rep = harness.tune_case(c["family"], c["params"], c["dtype"],
+                                    table=table, iters=iters,
+                                    warmup=warmup)
+        except (NotImplementedError, ValueError) as e:
+            print(f"{c['family']}: skipped — {e}")
+            continue
+        sig = tune_cache.make_sig(rep["params"])
+        print(f"kernel {rep['kernel']}  {sig}  dtype={c['dtype']}  "
+              f"device={rep['device_kind']}")
+        for r in rep["rows"]:
+            t = ("   FAILED numerics" if not r["numerics_ok"]
+                 else f"{r['median_s'] * 1e3:10.3f} ms")
+            marks = ("   (default)" if r["is_default"] else "") + \
+                    ("   <- best" if r["config"] == rep["best"] else "")
+            print(f"    {_fmt_cfg(r['config']):<28}{t}{marks}")
+        if "speedup_vs_default" in rep:
+            print(f"  best {_fmt_cfg(rep['best'])}: "
+                  f"{rep['speedup_vs_default']:.3f}x vs analytic default")
+    table.save(path)
+    print(f"tuned table written to {path} "
+          f"({len(table)} entries, fingerprint {table.fingerprint()})")
+    return 0
+
+
 def main(argv=None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     if not argv or argv[0] in ("-h", "--help", "help"):
@@ -251,6 +386,8 @@ def main(argv=None) -> int:
         return _cmd_merge_model(rest)
     if cmd == "serve":
         return _cmd_serve(rest)
+    if cmd == "tune":
+        return _cmd_tune(rest)
     if cmd == "flags":
         print(flags_help())
         return 0
@@ -260,7 +397,7 @@ def main(argv=None) -> int:
         print(full_version)
         return 0
     raise SystemExit(f"unknown command {cmd!r}; try: train, merge_model, "
-                     "serve, flags, version")
+                     "serve, tune, flags, version")
 
 
 if __name__ == "__main__":

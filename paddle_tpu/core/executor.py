@@ -20,7 +20,6 @@ XLA CSEs the duplicated forward, so this costs nothing at runtime.
 
 from __future__ import annotations
 
-import os
 from typing import Any, Dict, List, Optional, Sequence
 
 import jax
@@ -55,6 +54,13 @@ def memory_optimize(program=None, policy: str = "dots") -> None:
             f"{sorted(_REMAT_POLICIES)}"
         )
     program.remat_policy = policy
+
+
+def _tune_fingerprint() -> str:
+    """Lazy import: tune loads after core during package init."""
+    from ..tune import overrides as tune_overrides
+
+    return tune_overrides.fingerprint()
 
 
 def _check_finite(values: Dict[str, Any]) -> None:
@@ -353,10 +359,14 @@ class Executor:
             FLAGS.fused_conv_interpret,
             FLAGS.fused_conv_dot_max_n,
             FLAGS.stacked_lstm_single_scan,
-            # trace-affecting env override read in bahdanau _bblk: a
-            # tuning sweep flipping it on a live Executor must re-trace,
-            # not silently reuse the stale tile choice
-            os.environ.get("PT_ATTN_BBLK", ""),
+            # every trace-affecting kernel-config source (forced
+            # overrides, legacy env knobs like PT_ATTN_BBLK, the loaded
+            # tuned table) collapses into one fingerprint: a tuning
+            # sweep flipping ANY knob on a live Executor re-traces
+            # instead of silently reusing the stale tile choice, and
+            # future knobs invalidate the cache without touching this
+            # file (tune/overrides.py)
+            _tune_fingerprint(),
             _feed_signature(feed),
             tuple(fetch_names),
             tuple(persist_names),

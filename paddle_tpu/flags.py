@@ -203,6 +203,13 @@ define_flag("stacked_lstm_single_scan", False,
             "the noise floor (0.79x-1.30x across identical runs — "
             "benchmarks/stacked_book.json), so the batched default "
             "stands on the structural argument")
+define_flag("use_tuned_table", True,
+            "consult the persistent tuned-config table (paddle_tpu.tune, "
+            "`paddle_tpu tune`) for kernel tile/block choices before the "
+            "analytic defaults. Lookups are keyed by device_kind, so a "
+            "machine without tuned entries (or any non-TPU backend) "
+            "deterministically falls back to the analytic models; set 0 "
+            "to ignore tables entirely (A/B escape hatch)")
 define_flag("bn_bf16_stats", True,
             "batch_norm stats: square in the io dtype with f32 reduction "
             "accumulation instead of upcasting the activation first. "

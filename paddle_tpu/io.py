@@ -220,6 +220,17 @@ def save_inference_model(
                              "shape": [int(d) for d in v.shape]}
         except KeyError:
             pass
+    # tuned-kernel provenance travels with the artifact: which device
+    # the exporter's tuned table was measured for and its content hash,
+    # so serving.engine warmup can detect a stale/missing table on the
+    # serving host and warn instead of silently running untuned
+    from .tune import cache as _tune_cache
+    from .tune import overrides as _tune_overrides
+
+    tuning = {
+        "device_kind": _tune_cache.device_kind(),
+        "table_fingerprint": _tune_overrides.table().fingerprint(),
+    }
     with open(os.path.join(dirname, PROGRAM_FILE), "w") as f:
         json.dump(pruned.to_dict(), f)
     with open(os.path.join(dirname, META_FILE), "w") as f:
@@ -229,6 +240,7 @@ def save_inference_model(
                 "fetch_names": target_names,
                 "param_names": param_names,
                 "feed_specs": feed_specs,
+                "tuning": tuning,
             },
             f,
         )
@@ -246,6 +258,10 @@ def load_inference_model(dirname: str, scope: Optional[Scope] = None):
     # serving sidecar (absent in pre-serving artifacts): per-feed
     # dtype/shape specs, consumed by serving.ServingEngine
     program._serving_meta = meta.get("feed_specs") or None
+    # tuned-kernel provenance (absent in pre-tuner artifacts): the
+    # exporter's device_kind + tuned-table fingerprint, checked by
+    # serving.ServingEngine.warmup against the serving host's table
+    program._tuning_meta = meta.get("tuning") or None
     return program, meta["feed_names"], meta["fetch_names"]
 
 

@@ -102,14 +102,22 @@ def flash_eligible(q, k=None) -> bool:
     )
 
 
-def _v5e_block_sizes(Tq: int, Tk: int):
-    """v5e-tuned blocking (benchmarks/flash_block_tuning.json): 512-wide
-    q/k blocks win up to T=4096, 1024 from 8192; repeated-trial medians
-    confirm 512/512 at T=1024/2048 (1.4-1.5x over XLA). The kernel
-    requires blocks to DIVIDE the sequence length, so the target rounds
-    down to the largest 128-multiple divisor (e.g. T=1280 → 256; T is
-    always 128-aligned here per _shapes_flash_ok)."""
+def _v5e_block_sizes(Tq: int, Tk: int, dtype=None):
+    """Block choice for the TPU kernel. Consult order (tune/overrides):
+    forced/tuned {block_q, block_k} for this (Tq, Tk, dtype, device) —
+    validated against the shared legality predicate
+    (tune/space.flash_block_legal: blocks must DIVIDE the 128-aligned
+    sequence) — else the v5e-tuned analytic default
+    (benchmarks/flash_block_tuning.json): 512-wide q/k blocks win up to
+    T=4096, 1024 from 8192; repeated-trial medians confirm 512/512 at
+    T=1024/2048 (1.4-1.5x over XLA). The default rounds its target down
+    to the largest 128-multiple divisor (e.g. T=1280 → 256)."""
+    import numpy as np
+
     from jax.experimental.pallas.ops.tpu.flash_attention import BlockSizes
+
+    from ..tune import overrides as tune_overrides
+    from ..tune.space import flash_block_legal
 
     def blk(T):
         if T % 128:
@@ -123,7 +131,24 @@ def _v5e_block_sizes(Tq: int, Tk: int):
             b -= 128
         return b
 
-    qb, kb = blk(Tq), blk(Tk)
+    qb, kb = 0, 0
+    ov = tune_overrides.lookup(
+        "flash_attention", {"Tq": Tq, "Tk": Tk},
+        np.dtype(dtype).name if dtype is not None else "bfloat16")
+    if ov is not None:
+        oq = int(ov.config.get("block_q", 0))
+        ok = int(ov.config.get("block_k", 0))
+        if flash_block_legal(oq, ok, Tq, Tk):
+            qb, kb = oq, ok
+        elif ov.source in ("forced", "env"):
+            import warnings
+
+            warnings.warn(
+                f"forced flash blocks q={oq} k={ok} do not divide "
+                f"Tq={Tq} Tk={Tk}; using the analytic default",
+                stacklevel=2)
+    if not qb:
+        qb, kb = blk(Tq), blk(Tk)
     return BlockSizes(
         block_q=qb, block_k_major=kb, block_k=kb, block_b=1,
         block_q_major_dkv=qb, block_k_major_dkv=kb,
@@ -143,7 +168,7 @@ def _flash_kernel(q, k, v, causal: bool):
     o = _tpu_flash(
         bhtd(q), bhtd(k), bhtd(v), causal=causal,
         sm_scale=float(1.0 / math.sqrt(q.shape[-1])),
-        block_sizes=_v5e_block_sizes(q.shape[1], k.shape[1]),
+        block_sizes=_v5e_block_sizes(q.shape[1], k.shape[1], q.dtype),
     )
     return jnp.transpose(o, (0, 2, 1, 3))
 

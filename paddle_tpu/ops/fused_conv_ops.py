@@ -45,21 +45,38 @@ from jax.experimental.pallas import tpu as pltpu
 
 from .. import amp
 from ..core.registry import register_op
-from .pallas_kernels import _VMEM_BUDGET
 
 
 def _block_rows(n: int, cin: int, cout: int, itemsize: int) -> int:
-    """Largest row-block <= 1024 that divides n, tiles the 8-row sublane,
-    and fits the kernel working set (x/y blocks double-buffered by the
-    pipeline machinery, full weight panel, f32 accumulators) in VMEM.
-    Returns 0 when no eligible block exists."""
-    weight = cin * cout * itemsize
-    for b in (1024, 896, 768, 640, 512, 448, 384, 320, 256, 192, 128, 64,
-              32, 16, 8):
-        if n % b:
-            continue
-        io = 2 * b * (cin + cout) * itemsize
-        if weight + io + 2 * 4 * cout + 4 * cin * 4 <= _VMEM_BUDGET:
+    """Row block for the fused kernel. Legality (divides n, tiles the
+    8-row sublane, working set — x/y blocks double-buffered by the
+    pipeline machinery, full weight panel, f32 accumulators — under the
+    VMEM budget) lives in tune/space.py `conv_rows_legal`, shared with
+    the autotuner's candidate generator. Consult order: forced/tuned
+    override for this (n, cin, cout, dtype, device) -> the analytic
+    default (largest legal block <= 1024). Returns 0 when no eligible
+    block exists."""
+    from ..tune import overrides as tune_overrides
+    from ..tune.cache import ITEMSIZE_DTYPE
+    from ..tune.space import CONV_ROW_BLOCKS, conv_rows_legal
+
+    ov = tune_overrides.lookup(
+        "fused_conv", {"n": n, "cin": cin, "cout": cout},
+        ITEMSIZE_DTYPE.get(itemsize, f"itemsize{itemsize}"))
+    if ov is not None:
+        b = int(ov.config.get("block_rows", 0))
+        if b and conv_rows_legal(b, n, cin, cout, itemsize):
+            return b
+        if ov.source in ("forced", "env"):
+            import warnings
+
+            warnings.warn(
+                f"forced fused-conv block_rows={b} fails eligibility at "
+                f"n={n} cin={cin} cout={cout}; fused conv kernel "
+                f"DISABLED for this shape", stacklevel=2)
+            return 0
+    for b in CONV_ROW_BLOCKS:
+        if conv_rows_legal(b, n, cin, cout, itemsize):
             return b
     return 0
 

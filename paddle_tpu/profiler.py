@@ -15,7 +15,9 @@ the timed block so the timer measures device work, not enqueue time."""
 
 from __future__ import annotations
 
+import collections
 import contextlib
+import statistics
 import time
 from typing import Any, Dict, Optional
 
@@ -25,33 +27,54 @@ from .flags import FLAGS
 
 
 class Stat:
-    __slots__ = ("name", "count", "total", "max")
+    __slots__ = ("name", "count", "total", "max", "samples")
 
-    def __init__(self, name: str):
+    def __init__(self, name: str, keep_samples: int = 0):
         self.name = name
         self.count = 0
         self.total = 0.0
         self.max = 0.0
+        # opt-in raw-sample ring (the tune harness's median-of-k needs
+        # the distribution, not just the running aggregate); None keeps
+        # the default zero-overhead accumulator for serving timers
+        self.samples = (
+            collections.deque(maxlen=keep_samples) if keep_samples else None
+        )
 
     def add(self, dt: float) -> None:
         self.count += 1
         self.total += dt
         self.max = max(self.max, dt)
+        if self.samples is not None:
+            self.samples.append(dt)
 
     @property
     def avg(self) -> float:
         return self.total / self.count if self.count else 0.0
 
+    @property
+    def median(self) -> float:
+        """Median of the retained samples; falls back to avg when
+        sample retention is off (keep_samples=0)."""
+        if not self.samples:
+            return self.avg
+        return statistics.median(self.samples)
+
 
 class StatSet:
-    """Named timer accumulator (reference: StatSet, Stat.h:230)."""
+    """Named timer accumulator (reference: StatSet, Stat.h:230).
 
-    def __init__(self):
+    `keep_samples=k` makes every Stat retain its last k raw timings
+    (deque ring) so `Stat.median` is exact — used by tune/harness.py's
+    median-of-k measurement loop."""
+
+    def __init__(self, keep_samples: int = 0):
+        self.keep_samples = keep_samples
         self.stats: Dict[str, Stat] = {}
 
     def get(self, name: str) -> Stat:
         if name not in self.stats:
-            self.stats[name] = Stat(name)
+            self.stats[name] = Stat(name, self.keep_samples)
         return self.stats[name]
 
     @contextlib.contextmanager

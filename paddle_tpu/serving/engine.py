@@ -151,6 +151,9 @@ class ServingEngine:
                 except KeyError:
                     spec = {"dtype": "float32", "shape": []}
             self.feed_specs[n] = spec
+        # tuned-kernel provenance from meta.json (io.save_inference_model
+        # since the tuner PR): exporter device_kind + table fingerprint
+        self.tuning_meta = getattr(self.program, "_tuning_meta", None)
         self.exe = Executor()
         self.metrics = metrics or MetricSet(
             stat_set=profiler.global_stat_set())
@@ -288,13 +291,47 @@ class ServingEngine:
         return outs
 
     # ------------------------------------------------------------------
+    def check_tuned_table(self) -> bool:
+        """Compare the model's recorded tuning provenance (exporter
+        device_kind + tuned-table fingerprint, meta.json) against this
+        process's table. A mismatch means the kernels the exporter
+        measured are NOT what this host will dispatch — warn loudly
+        (warmup calls this) instead of silently serving untuned/stale
+        configs. Returns True when provenance matches or the artifact
+        predates the tuner."""
+        if not self.tuning_meta:
+            return True  # pre-tuner artifact: nothing recorded
+        from ..tune import cache as tune_cache
+        from ..tune import overrides as tune_overrides
+
+        saved_kind = self.tuning_meta.get("device_kind")
+        saved_fp = self.tuning_meta.get("table_fingerprint")
+        cur_kind = tune_cache.device_kind()
+        cur_fp = tune_overrides.table().fingerprint()
+        if saved_kind == cur_kind and saved_fp == cur_fp:
+            return True
+        import warnings
+
+        warnings.warn(
+            f"model {self.model_name!r} was exported with tuned-kernel "
+            f"table {saved_fp} on device {saved_kind!r}; this process "
+            f"has table {cur_fp} on {cur_kind!r} — serving may run "
+            "untuned or stale kernel configs (re-run `paddle_tpu tune` "
+            "on this host and re-export, or ship the exporter's table "
+            "via PT_TUNE_CACHE)", stacklevel=2)
+        return False
+
     def warmup(self) -> int:
         """Pre-compile every bucket program derivable from the model's
         feed specs (zero feeds at each bucket geometry), so live
         traffic never pays a cold trace+compile — the CLI does this at
-        startup. Returns the number of bucket programs touched; models
-        whose feed shapes aren't fully concrete past the batch axis
-        are skipped (their buckets compile lazily)."""
+        startup. Also cross-checks the model's tuned-table provenance
+        (check_tuned_table) so a stale table is warned about at startup,
+        not discovered in a latency regression. Returns the number of
+        bucket programs touched; models whose feed shapes aren't fully
+        concrete past the batch axis are skipped (their buckets compile
+        lazily)."""
+        self.check_tuned_table()
         pol = self.policy
         compiled = 0
         for nb in pol.batch_buckets:

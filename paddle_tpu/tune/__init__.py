@@ -1,0 +1,51 @@
+"""paddle_tpu.tune: empirical kernel autotuner with a persistent
+per-device config cache.
+
+Why this exists: every fused Pallas kernel in the repo picks its tile
+sizes from hand-derived analytic cost models (`_bblk` in
+ops/bahdanau_kernels.py, `_v5e_block_sizes` in ops/flash_ops.py,
+`_block_rows` in ops/fused_conv_ops.py, the measured H-windows in
+ops/pallas_kernels.py). Those models encode one device generation's
+measurements — the bahdanau comment itself records a 256k-vs-217k tok/s
+gap found only by hand-sweeping PT_ATTN_BBLK. CLBlast (arXiv:1705.05249)
+and the per-shape serving buckets in paddle_tpu.serving both apply the
+same lesson: empirical per-device, per-shape search beats analytic
+defaults across hardware generations, IF the search result is cached and
+consulted as a first-class input to dispatch.
+
+Module layout:
+
+  space.py     per-kernel candidate generators. The legality predicates
+               (Mosaic tile rules + the VMEM-budget models) are defined
+               HERE and imported by the runtime kernels, so the tuner
+               can never emit a config the runtime would reject, and the
+               runtime can never accept a config the tuner can't
+               enumerate.
+  harness.py   the measurement loop: compile each candidate, warm up,
+               median-of-k wall timing via profiler.StatSet, numeric
+               cross-check against the reference lowering. REFUSES to
+               time on non-TPU backends (a CPU timing would poison the
+               per-device table) — lookups then fall back to analytic
+               defaults deterministically.
+  cache.py     the persistent JSON table keyed by (kernel,
+               shape-signature, dtype, device_kind): atomic writes,
+               schema versioning, corrupt-file recovery, an in-process
+               LRU front.
+  overrides.py the one consult point kernels call at trace time:
+               forced override (programmatic or env, e.g. PT_ATTN_BBLK)
+               -> tuned table -> None (analytic default). Also exports
+               the fingerprint the Executor folds into its jit cache
+               key, so flipping ANY kernel knob re-traces instead of
+               silently reusing a stale tile choice.
+
+CLI: `python -m paddle_tpu tune --kernel bahdanau --shape B=256,S=60,\
+A=512,C=512 [--dry-run]` — see cli.py.
+"""
+
+from . import cache  # noqa: F401
+from . import space  # noqa: F401
+from . import overrides  # noqa: F401
+from . import harness  # noqa: F401
+from .cache import TunedTable, device_kind  # noqa: F401
+from .harness import TuningUnavailable, tune_case  # noqa: F401
+from .overrides import force, forcing, lookup  # noqa: F401

@@ -1,0 +1,195 @@
+"""Persistent tuned-config table: JSON on disk, LRU in process.
+
+Key model (the CLBlast lesson, arXiv:1705.05249 §4): a tuned config is
+only valid for the exact (kernel family, shape signature, dtype, device
+kind) it was measured on — a v5e-optimal tile is a guess on v4, and a
+bf16 tile model doubles its VMEM take at f32. The table therefore keys
+on all four, and lookups from a different device kind simply miss (the
+runtime then uses its analytic default — the same code path as an
+untuned machine, so shipping a table can never CHANGE behavior on
+hardware it wasn't measured on).
+
+Durability discipline:
+- writes are atomic (tempfile in the target dir + os.replace), so a
+  killed tune run can't leave a half-written table for every later
+  process to choke on;
+- the file carries a schema version; a version mismatch is ignored with
+  a warning (forward-compat: an old runtime reading a new table must
+  fall back to analytic defaults, not crash);
+- a corrupt file (truncated, hand-edited, wrong types) is moved aside
+  to `<path>.corrupt` and an empty table takes its place — the tuner
+  must never be able to break model execution;
+- reads go through a small in-process LRU front so the per-trace lookup
+  cost is a dict hit, not repeated signature formatting.
+"""
+
+from __future__ import annotations
+
+import collections
+import hashlib
+import json
+import os
+import tempfile
+import warnings
+from typing import Any, Dict, Optional
+
+TABLE_VERSION = 1
+_LRU_CAP = 512
+
+# itemsize -> dtype name for kernels whose shape model only sees the io
+# itemsize (bahdanau _bblk, the RNN eligibility): the fused families
+# admit exactly bf16/f32, so the mapping is bijective
+ITEMSIZE_DTYPE = {2: "bfloat16", 4: "float32"}
+
+
+def device_kind() -> str:
+    """Canonical device identity for table keys: jax's device_kind
+    string (e.g. 'TPU v5 lite'), lowercased with spaces collapsed so the
+    key survives JSON round-trips and shell quoting. 'cpu' off-TPU —
+    which is exactly why CPU test runs can never hit TPU-tuned entries."""
+    import jax
+
+    try:
+        kind = jax.devices()[0].device_kind
+    except Exception:  # no backend at all — still a valid (empty) key
+        kind = "unknown"
+    return "-".join(str(kind).lower().split())
+
+
+def make_sig(params: Dict[str, Any]) -> str:
+    """Canonical shape signature: sorted k=v pairs. Params must be
+    scalars (ints/strs) — the signature is a JSON object key. A 'dtype'
+    key is excluded: dtype is its own key dimension (space.normalize
+    carries it inside params for the candidate generators, runtime
+    lookups pass pure shape dicts — both must map to one signature)."""
+    return ",".join(f"{k}={params[k]}" for k in sorted(params)
+                    if k != "dtype")
+
+
+def entry_key(kernel: str, sig: str, dtype: str, device: str) -> str:
+    return "|".join((kernel, sig, dtype, device))
+
+
+class TunedTable:
+    """entries: key -> {"config": {...}, "meta": {...}}."""
+
+    def __init__(self, path: Optional[str] = None, autoload: bool = True):
+        self.path = path
+        self.entries: Dict[str, Dict[str, Any]] = {}
+        self._lru: "collections.OrderedDict[str, Any]" = (
+            collections.OrderedDict())
+        self._fp: Optional[str] = None
+        if path and autoload:
+            self.load(path)
+
+    # -------------------------------------------------------- lookups --
+    def get(self, kernel: str, params: Dict[str, Any], dtype: str,
+            device: Optional[str] = None) -> Optional[Dict[str, Any]]:
+        key = entry_key(kernel, make_sig(params), dtype,
+                        device if device is not None else device_kind())
+        if key in self._lru:
+            self._lru.move_to_end(key)
+            cfg = self._lru[key]
+        else:
+            e = self.entries.get(key)
+            cfg = dict(e["config"]) if e else None
+            self._lru[key] = cfg
+            if len(self._lru) > _LRU_CAP:
+                self._lru.popitem(last=False)
+        # fresh dict per caller: a consumer mutating its config must not
+        # corrupt the cached copy
+        return dict(cfg) if cfg is not None else None
+
+    def put(self, kernel: str, params: Dict[str, Any], dtype: str,
+            config: Dict[str, Any], device: Optional[str] = None,
+            meta: Optional[Dict[str, Any]] = None) -> str:
+        key = entry_key(kernel, make_sig(params), dtype,
+                        device if device is not None else device_kind())
+        self.entries[key] = {"config": dict(config),
+                             "meta": dict(meta or {})}
+        self._lru.pop(key, None)
+        self._fp = None
+        return key
+
+    def __len__(self) -> int:
+        return len(self.entries)
+
+    def fingerprint(self) -> str:
+        """Content hash over the entry set — folded into the Executor's
+        jit cache key (a reloaded/retuned table must re-trace) and
+        recorded in saved-model metadata (serving detects staleness)."""
+        if self._fp is None:
+            blob = json.dumps(self.entries, sort_keys=True).encode()
+            self._fp = hashlib.sha1(blob).hexdigest()[:16]
+        return self._fp
+
+    # ------------------------------------------------------------- io --
+    def load(self, path: Optional[str] = None) -> "TunedTable":
+        path = path or self.path
+        self.path = path
+        self.entries = {}
+        self._lru.clear()
+        self._fp = None
+        if not path or not os.path.exists(path):
+            return self
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+            if not isinstance(doc, dict):
+                raise ValueError("table root must be an object")
+            if doc.get("version") != TABLE_VERSION:
+                warnings.warn(
+                    f"tuned table {path} has schema version "
+                    f"{doc.get('version')!r} (this runtime reads "
+                    f"{TABLE_VERSION}); ignoring it — analytic defaults "
+                    "apply", stacklevel=2)
+                return self
+            entries = doc.get("entries", {})
+            if not isinstance(entries, dict) or not all(
+                    isinstance(e, dict) and isinstance(e.get("config"), dict)
+                    for e in entries.values()):
+                raise ValueError("malformed entries")
+            self.entries = entries
+        except (json.JSONDecodeError, ValueError, KeyError, TypeError) as e:
+            quarantine = path + ".corrupt"
+            try:
+                os.replace(path, quarantine)
+                moved = f"; moved aside to {quarantine}"
+            except OSError:
+                moved = ""
+            warnings.warn(
+                f"tuned table {path} is corrupt ({e}){moved}; starting "
+                "empty — analytic defaults apply", stacklevel=2)
+        return self
+
+    def save(self, path: Optional[str] = None) -> str:
+        path = path or self.path
+        if not path:
+            raise ValueError("TunedTable.save: no path configured")
+        self.path = path
+        d = os.path.dirname(os.path.abspath(path))
+        os.makedirs(d, exist_ok=True)
+        doc = {"version": TABLE_VERSION, "device_kind": device_kind(),
+               "entries": self.entries}
+        fd, tmp = tempfile.mkstemp(dir=d, prefix=".tuned-", suffix=".json")
+        try:
+            with os.fdopen(fd, "w") as f:
+                json.dump(doc, f, indent=1, sort_keys=True)
+            os.replace(tmp, path)  # atomic on POSIX
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+        return path
+
+
+def default_path() -> str:
+    """PT_TUNE_CACHE env, else the XDG-ish per-user location."""
+    env = os.environ.get("PT_TUNE_CACHE")
+    if env:
+        return env
+    base = os.environ.get("XDG_CACHE_HOME",
+                          os.path.join(os.path.expanduser("~"), ".cache"))
+    return os.path.join(base, "paddle_tpu", "tuned.json")

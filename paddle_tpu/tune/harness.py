@@ -1,0 +1,158 @@
+"""Empirical measurement loop: compile, warm up, time, cross-check.
+
+Methodology (the CLBlast recipe, arXiv:1705.05249 §3, adapted to XLA):
+
+- each candidate config is traced+compiled with the config FORCED in
+  the override registry (overrides.forcing), so the measurement
+  exercises the exact consult path production dispatch uses;
+- warmup runs absorb the compile + first-dispatch cost, then the timed
+  runs block on the result (`jax.block_until_ready`) so the timer sees
+  device work, not async enqueue (profiler.py's design note);
+- the score is the MEDIAN of k timed runs (profiler.Stat keeps the
+  samples when asked) — medians shrug off the one-off d2h/interrupt
+  outliers that poisoned round-1's RNN measurements (PERF.md);
+- every candidate's output is cross-checked against the family's
+  reference lowering before it may win: a fast-but-wrong tile (e.g. one
+  that silently overflows an accumulator) must never enter the table.
+
+Determinism guard: timing is REFUSED off-TPU (TuningUnavailable) — a
+CPU/interpret timing would write meaningless configs into the
+per-device table, and the tier-1 CPU suite must stay byte-deterministic.
+Lookups off-TPU still work and simply miss (device_kind mismatch), so
+the untimed path falls back to analytic defaults deterministically.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from .. import profiler
+from . import cache as _cache
+from . import overrides, space
+
+
+class TuningUnavailable(RuntimeError):
+    """Raised when empirical timing is requested on a backend whose
+    timings must not enter the per-device table."""
+
+
+def ensure_timeable() -> None:
+    import jax
+
+    backend = jax.default_backend()
+    if backend != "tpu":
+        raise TuningUnavailable(
+            f"refusing to time kernels on backend {backend!r}: empirical "
+            "timings off-TPU would poison the per-device table. Run on "
+            "TPU hardware, or use --dry-run to list candidates.")
+
+
+def measure(thunk, iters: int = 5, warmup: int = 2,
+            stat_set: Optional[profiler.StatSet] = None,
+            name: str = "tune/measure") -> float:
+    """Median-of-k wall seconds for `thunk()` (a zero-arg compiled
+    call). Samples land in a StatSet so the full distribution is
+    inspectable (`stat_set.get(name).samples`)."""
+    import jax
+
+    stats = stat_set if stat_set is not None \
+        else profiler.StatSet(keep_samples=iters)
+    for _ in range(max(0, warmup)):
+        jax.block_until_ready(thunk())
+    for _ in range(max(1, iters)):
+        with stats.timer(name, always=True):
+            jax.block_until_ready(thunk())
+    return stats.get(name).median
+
+
+def _numerics_ok(got, want: List[np.ndarray], tol: float) -> bool:
+    import jax
+
+    got_leaves = [np.asarray(g, np.float32)
+                  for g in jax.tree_util.tree_leaves(got)]
+    if len(got_leaves) != len(want):
+        return False
+    return all(
+        np.allclose(g, np.asarray(w, np.float32), rtol=tol, atol=tol)
+        for g, w in zip(got_leaves, want))
+
+
+def tune_case(family: str, params: Dict[str, Any], dtype: str,
+              table: Optional[_cache.TunedTable] = None,
+              iters: int = 5, warmup: int = 2,
+              require_tpu: bool = True) -> Dict[str, Any]:
+    """Sweep one (kernel family, shape, dtype) case: time every legal
+    candidate, cross-check numerics, optionally record the winner in
+    `table`. Returns the report dict the CLI renders:
+
+      {kernel, params, dtype, device_kind, default, best,
+       rows: [{config, median_s, numerics_ok, is_default}, ...]}
+
+    `require_tpu=False` exists for the CPU test suite to exercise the
+    loop mechanics in interpret mode — production entry points
+    (cli tune) always require TPU.
+    """
+    fam = space.get_family(family)
+    params = fam.normalize(params, dtype)
+    if require_tpu:
+        ensure_timeable()
+    cands = fam.candidates(params)
+    if not cands:
+        raise ValueError(
+            f"{fam.name}: no legal candidates at {params} — the shape "
+            "is outside the fused kernel's eligibility entirely")
+    default_cfg = fam.default(params)
+    case = fam.make_case(params, dtype)
+    ref = case.reference()
+
+    rows = []
+    for cfg in cands:
+        thunk = case.make(cfg)
+        ok = _numerics_ok(thunk(), ref, case.tol)
+        med = measure(thunk, iters=iters, warmup=warmup,
+                      name=f"tune/{fam.name}") if ok else float("inf")
+        rows.append({"config": cfg, "median_s": med, "numerics_ok": ok,
+                     "is_default": cfg == default_cfg})
+    usable = [r for r in rows if r["numerics_ok"]]
+    if not usable:
+        raise RuntimeError(
+            f"{fam.name}: every candidate failed the numeric cross-check "
+            f"at {params} — refusing to tune (kernel bug, not a slow "
+            "config)")
+    best = min(usable, key=lambda r: r["median_s"])
+    report = {
+        "kernel": fam.name,
+        "params": params,
+        "dtype": dtype,
+        "device_kind": _cache.device_kind(),
+        "default": default_cfg,
+        "best": best["config"],
+        "rows": rows,
+    }
+    default_row = next((r for r in rows if r["is_default"]), None)
+    if default_row is not None and default_row["numerics_ok"]:
+        report["speedup_vs_default"] = (
+            default_row["median_s"] / best["median_s"]
+            if best["median_s"] > 0 else 1.0)
+    if table is not None:
+        table.put(fam.name, params, dtype, best["config"],
+                  meta={"median_s": best["median_s"], "iters": iters,
+                        "default": default_cfg})
+    return report
+
+
+def list_candidates(family: str, params: Dict[str, Any],
+                    dtype: str) -> Dict[str, Any]:
+    """The --dry-run half: enumerate legal candidates without compiling
+    or timing anything (works on any backend)."""
+    fam = space.get_family(family)
+    params = fam.normalize(params, dtype)
+    return {
+        "kernel": fam.name,
+        "params": params,
+        "dtype": dtype,
+        "default": fam.default(params),
+        "candidates": fam.candidates(params),
+    }

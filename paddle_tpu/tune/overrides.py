@@ -1,0 +1,182 @@
+"""Central per-kernel config override registry.
+
+Every tunable kernel consults THIS module at trace time instead of
+reading env vars or tables itself. Lookup precedence:
+
+  1. forced override — programmatic `force()` (the harness pins each
+     candidate this way while timing it) or a legacy env knob
+     (PT_ATTN_BBLK keeps working, routed through here);
+  2. the persistent tuned table (tune/cache.py), keyed by (kernel,
+     shape signature, dtype, device_kind) — misses on any device the
+     table wasn't measured on;
+  3. None — the caller applies its analytic default.
+
+The consumer contract (see ops/bahdanau_kernels._bblk): a FORCED config
+that fails the family's legality predicate warns and disables the fused
+path (the operator asked for exactly that tile; silently substituting
+another would invalidate their sweep), while a stale TABLE entry that
+fails legality is ignored and the analytic default applies (a shipped
+table must never break a model). `Override.source` tells the two apart.
+
+`fingerprint()` is the piece the Executor folds into its jit cache key:
+a content hash over everything that can change a lookup result — forced
+configs, legacy env knobs, the loaded table, and FLAGS.use_tuned_table —
+so ANY future kernel knob invalidates the jit cache without the
+executor learning about it (this replaced the raw PT_ATTN_BBLK string
+in core/executor.py).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import hashlib
+import json
+import os
+import threading
+from typing import Any, Dict, NamedTuple, Optional
+
+from ..flags import FLAGS
+from . import cache as _cache
+
+# legacy env knobs, mapped into override configs: kernel -> (env var,
+# config key, parser). A parsed value of 0/empty means "unset" (the
+# pre-tuner PT_ATTN_BBLK semantics).
+ENV_KNOBS = {
+    "bahdanau_attention": ("PT_ATTN_BBLK", "bblk", int),
+}
+
+
+class Override(NamedTuple):
+    config: Dict[str, Any]
+    source: str  # "forced" | "env" | "table"
+
+
+_lock = threading.RLock()
+_forced: Dict[str, Dict[str, Any]] = {}
+_table: Optional[_cache.TunedTable] = None
+_table_path: Optional[str] = None  # None -> flag/env/default resolution
+
+
+# ------------------------------------------------------------- forcing --
+def force(kernel: str, config: Optional[Dict[str, Any]]) -> None:
+    """Pin (or with None, unpin) a kernel family's config
+    process-wide. Takes effect at the next trace — the Executor's cache
+    key includes fingerprint(), so the next run() re-traces."""
+    with _lock:
+        if config is None:
+            _forced.pop(kernel, None)
+        else:
+            _forced[kernel] = dict(config)
+
+
+@contextlib.contextmanager
+def forcing(kernel: str, config: Optional[Dict[str, Any]]):
+    """Scoped force() — the harness traces each candidate under this."""
+    with _lock:
+        prev = _forced.get(kernel)
+    force(kernel, config)
+    try:
+        yield
+    finally:
+        force(kernel, prev)
+
+
+def _env_override(kernel: str) -> Optional[Dict[str, Any]]:
+    knob = ENV_KNOBS.get(kernel)
+    if not knob:
+        return None
+    env_var, key, parse = knob
+    raw = os.environ.get(env_var)
+    if not raw:
+        return None
+    try:
+        val = parse(raw)
+    except (TypeError, ValueError):
+        return None
+    return {key: val} if val else None
+
+
+def forced_config(kernel: str) -> Optional[Override]:
+    """Forced layer only (programmatic beats env)."""
+    with _lock:
+        cfg = _forced.get(kernel)
+    if cfg is not None:
+        return Override(dict(cfg), "forced")
+    env = _env_override(kernel)
+    if env is not None:
+        return Override(env, "env")
+    return None
+
+
+# --------------------------------------------------------------- table --
+def table() -> _cache.TunedTable:
+    """The process's tuned table, lazily loaded from set_table_path()
+    else PT_TUNE_CACHE else the per-user default. A missing file is an
+    empty table (every lookup misses -> analytic defaults)."""
+    global _table
+    with _lock:
+        if _table is None:
+            _table = _cache.TunedTable(_table_path or _cache.default_path())
+        return _table
+
+
+def set_table_path(path: Optional[str]) -> None:
+    """Point the registry at a table file (None reverts to the
+    default resolution); the current table is dropped and reloaded
+    lazily."""
+    global _table, _table_path
+    with _lock:
+        _table_path = path
+        _table = None
+
+
+def reload_table() -> None:
+    """Drop the in-memory table so the next lookup rereads the file —
+    call after an external tune run wrote new entries."""
+    global _table
+    with _lock:
+        _table = None
+
+
+# -------------------------------------------------------------- lookup --
+def lookup(kernel: str, params: Dict[str, Any],
+           dtype: str) -> Optional[Override]:
+    """The one consult point kernels call at trace time. `params` is
+    the family's canonical shape dict (space.KernelSpace.param_names
+    order is irrelevant — the signature sorts); `dtype` the io dtype
+    name ('bfloat16'/'float32')."""
+    f = forced_config(kernel)
+    if f is not None:
+        return f
+    if not FLAGS.use_tuned_table:
+        return None
+    cfg = table().get(kernel, params, dtype)
+    if cfg is not None:
+        return Override(cfg, "table")
+    return None
+
+
+# --------------------------------------------------------- fingerprint --
+def fingerprint() -> str:
+    """Content hash over every override source. Folded into the
+    Executor jit cache key: any knob change — a forced config, a legacy
+    env sweep variable, a retuned/reloaded table, the use_tuned_table
+    flag — re-traces instead of silently reusing a stale kernel
+    config."""
+    with _lock:
+        forced = {k: _forced[k] for k in sorted(_forced)}
+    env = {var: os.environ.get(var, "")
+           for (var, _, _) in ENV_KNOBS.values()}
+    use_table = bool(FLAGS.use_tuned_table)
+    tbl = table().fingerprint() if use_table else ""
+    blob = json.dumps([forced, env, use_table, tbl], sort_keys=True)
+    return hashlib.sha1(blob.encode()).hexdigest()[:16]
+
+
+def reset() -> None:
+    """Test isolation: clear forced configs and drop the table."""
+    global _table, _table_path
+    with _lock:
+        _forced.clear()
+        _table = None
+        _table_path = None

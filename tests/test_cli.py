@@ -207,3 +207,50 @@ def test_cli_serve_end_to_end(tmp_path):
     finally:
         proc.terminate()
         proc.wait(timeout=30)
+
+
+def test_cli_tune_dry_run(tmp_path):
+    """`tune --dry-run` lists legal candidates for at least two kernel
+    families on any backend (no timing, no TPU)."""
+    r = _run(["tune", "--kernel", "bahdanau",
+              "--shape", "B=256,S=60,A=512,C=512", "--dtype", "bf16",
+              "--dry-run"], str(tmp_path))
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "kernel bahdanau_attention" in r.stdout
+    assert "bblk=8   (analytic default)" in r.stdout
+    r = _run(["tune", "--kernel", "flash", "--shape", "Tq=1024,Tk=1024",
+              "--dry-run"], str(tmp_path))
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "kernel flash_attention" in r.stdout
+    assert "block_k=512,block_q=512   (analytic default)" in r.stdout
+
+
+def test_cli_tune_refuses_to_time_on_cpu(tmp_path):
+    """Without --dry-run, timing on a CPU backend must refuse loudly
+    (the per-device table stays TPU-only) — determinism guard."""
+    r = _run(["tune", "--kernel", "bahdanau",
+              "--shape", "B=16,S=10,A=128,C=128"], str(tmp_path))
+    assert r.returncode != 0
+    assert "refusing to time" in (r.stderr + r.stdout)
+
+
+def test_cli_tune_config_sweep_dry_run(tmp_path):
+    """`tune --config model.py --dry-run` scans the model program for
+    tunable kernel sites."""
+    cfg = tmp_path / "attn_model.py"
+    cfg.write_text("""
+import numpy as np
+import paddle_tpu as pt
+
+def get_model():
+    q = pt.layers.data("q", shape=[1024, 256])
+    out = pt.layers.multi_head_attention(q, num_heads=2)
+    loss = pt.layers.mean(out)
+    def reader():
+        yield {"q": np.zeros((2, 1024, 256), np.float32)}
+    return {"cost": loss, "reader": reader}
+""")
+    r = _run(["tune", "--config", str(cfg), "--dry-run"], str(tmp_path))
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "kernel flash_attention" in r.stdout
+    assert "Tk=1024,Tq=1024" in r.stdout

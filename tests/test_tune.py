@@ -1,0 +1,484 @@
+"""Autotuner subsystem tests (paddle_tpu/tune/).
+
+The contracts under test, in dependency order:
+- space: every candidate a generator emits passes the SHARED legality
+  predicate, and the runtime accepts exactly that config (the property
+  that makes "tuner can never emit an illegal tile" true);
+- cache: JSON table round-trips, atomic-ish save, corrupt-file
+  recovery, schema-version gating, fingerprint stability;
+- overrides: precedence (forced > env > table > analytic), the legacy
+  PT_ATTN_BBLK env knob routed through the registry, fingerprint
+  reactivity (the Executor jit-cache-key contract);
+- harness: the CPU determinism guard (refuses to time off-TPU), and
+  the measurement loop mechanics in interpret mode;
+- golden numerics: a forced tuned config reproduces the analytic
+  default path bit-for-bit (tile size partitions the batch; per-row
+  math must be identical);
+- io/serving: tuning provenance travels in meta.json and warmup warns
+  on a stale table.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as pt
+from paddle_tpu.flags import FLAGS
+from paddle_tpu.tune import cache as tcache
+from paddle_tpu.tune import harness, overrides, space
+
+
+@pytest.fixture
+def tmp_table(tmp_path):
+    path = str(tmp_path / "tuned.json")
+    overrides.set_table_path(path)
+    yield path
+    overrides.reset()
+
+
+# ----------------------------------------------------------- space ------
+BAHDANAU_GRID = [
+    # (B, S, A, C, dtype)
+    (8, 10, 128, 128, "float32"),
+    (16, 60, 512, 512, "bfloat16"),
+    (256, 60, 512, 512, "bfloat16"),
+    (4, 7, 128, 256, "float32"),
+    (2, 100, 128, 128, "bfloat16"),
+    (24, 33, 256, 128, "float32"),
+]
+
+
+@pytest.mark.parametrize("B,S,A,C,dtype", BAHDANAU_GRID)
+def test_bahdanau_candidates_all_legal(B, S, A, C, dtype):
+    """Property: every emitted candidate passes the shared legality
+    predicate AND is accepted verbatim by the runtime's _bblk when
+    forced — no candidate can compile-fail on Mosaic tile rules."""
+    from paddle_tpu.ops.bahdanau_kernels import _bblk
+
+    Sp = space.pad_s(S)
+    item = 2 if dtype == "bfloat16" else 4
+    params = {"B": B, "Sp": Sp, "A": A, "C": C, "dtype": dtype}
+    cands = space.bahdanau_candidates(params)
+    assert cands, f"no candidates at {params}"
+    for cfg in cands:
+        b = cfg["bblk"]
+        assert space.bahdanau_blk_legal(b, B, Sp, A, C, item), cfg
+        # Mosaic divisibility rules restated independently:
+        assert B % b == 0
+        assert b % 8 == 0 or b == B
+        with overrides.forcing("bahdanau_attention", cfg):
+            assert _bblk(B, Sp, A, C, item) == b
+    # the analytic default is itself in the candidate set
+    default = space.bahdanau_default(params)
+    assert default in cands
+
+
+def test_flash_and_conv_candidates_all_legal():
+    for Tq, Tk in [(1024, 1024), (2048, 512), (4096, 4096), (1280, 1280)]:
+        cands = space.flash_candidates({"Tq": Tq, "Tk": Tk})
+        assert cands
+        for cfg in cands:
+            assert space.flash_block_legal(cfg["block_q"], cfg["block_k"],
+                                           Tq, Tk), (cfg, Tq, Tk)
+        assert space.flash_default({"Tq": Tq, "Tk": Tk}) in cands
+    for n, cin, cout in [(2048, 128, 512), (1024, 256, 256),
+                         (8 * 3 * 7, 128, 128)]:
+        params = {"n": n, "cin": cin, "cout": cout, "dtype": "bfloat16"}
+        cands = space.conv_candidates(params)
+        assert cands
+        for cfg in cands:
+            assert space.conv_rows_legal(cfg["block_rows"], n, cin, cout, 2)
+        assert space.conv_default(params) in cands
+
+
+def test_rnn_space_matches_runtime_default():
+    """The fused_lstm/fused_gru default mirrors lstm_supported /
+    gru_supported exactly (same measured windows + hard gates)."""
+    from paddle_tpu.ops.pallas_kernels import gru_supported, lstm_supported
+
+    prev = FLAGS.fused_rnn_interpret
+    FLAGS.fused_rnn_interpret = True  # neutralize the backend gate
+    try:
+        for B, H in [(128, 512), (128, 384), (128, 256), (64, 1280),
+                     (8, 128), (12, 128)]:
+            p = {"B": B, "H": H, "dtype": "bfloat16"}
+            assert space._rnn_default("lstm")(p)["fused"] == lstm_supported(
+                B, H, "sigmoid", "tanh", "tanh", None, itemsize=2)
+            assert space._rnn_default("gru")(p)["fused"] == gru_supported(
+                B, H, "sigmoid", "tanh", itemsize=2)
+    finally:
+        FLAGS.fused_rnn_interpret = prev
+
+
+# ----------------------------------------------------------- cache ------
+def test_cache_round_trip(tmp_path):
+    path = str(tmp_path / "t.json")
+    t = tcache.TunedTable(path, autoload=False)
+    params = {"B": 16, "Sp": 16, "A": 128, "C": 128}
+    t.put("bahdanau_attention", params, "float32", {"bblk": 16},
+          device="cpu", meta={"median_s": 1e-3})
+    fp = t.fingerprint()
+    t.save()
+    t2 = tcache.TunedTable(path)
+    assert t2.get("bahdanau_attention", params, "float32",
+                  device="cpu") == {"bblk": 16}
+    assert t2.fingerprint() == fp
+    # dtype and device are key dimensions: both must miss
+    assert t2.get("bahdanau_attention", params, "bfloat16",
+                  device="cpu") is None
+    assert t2.get("bahdanau_attention", params, "float32",
+                  device="tpu-v5-lite") is None
+    # a 'dtype' key inside params must not change the signature
+    # (space.normalize carries it; runtime lookups don't)
+    assert t2.get("bahdanau_attention", dict(params, dtype="float32"),
+                  "float32", device="cpu") == {"bblk": 16}
+
+
+def test_cache_corrupt_file_recovery(tmp_path):
+    path = str(tmp_path / "t.json")
+    with open(path, "w") as f:
+        f.write('{"version": 1, "entries": {truncated')
+    with pytest.warns(UserWarning, match="corrupt"):
+        t = tcache.TunedTable(path)
+    assert len(t) == 0
+    assert os.path.exists(path + ".corrupt")
+    assert not os.path.exists(path)
+    # the quarantined table must not break a subsequent save/load cycle
+    t.put("k", {"a": 1}, "float32", {"x": 1}, device="cpu")
+    t.save()
+    assert tcache.TunedTable(path).get("k", {"a": 1}, "float32",
+                                       device="cpu") == {"x": 1}
+
+
+def test_cache_version_mismatch_ignored(tmp_path):
+    path = str(tmp_path / "t.json")
+    with open(path, "w") as f:
+        json.dump({"version": 999, "entries": {
+            "k|a=1|float32|cpu": {"config": {"x": 1}, "meta": {}}}}, f)
+    with pytest.warns(UserWarning, match="schema version"):
+        t = tcache.TunedTable(path)
+    assert len(t) == 0  # analytic defaults apply
+
+
+def test_cache_missing_file_is_empty(tmp_path):
+    t = tcache.TunedTable(str(tmp_path / "absent.json"))
+    assert len(t) == 0
+    assert t.get("k", {"a": 1}, "float32") is None
+
+
+# ------------------------------------------------------- overrides ------
+def test_override_precedence(tmp_table, monkeypatch):
+    from paddle_tpu.ops.bahdanau_kernels import _bblk
+
+    params = {"B": 16, "Sp": 16, "A": 128, "C": 128}
+    # table layer
+    t = overrides.table()
+    t.put("bahdanau_attention", params, "float32", {"bblk": 16})
+    assert _bblk(16, 16, 128, 128, 4) == 16
+    # env layer beats table (legacy PT_ATTN_BBLK still honored)
+    monkeypatch.setenv("PT_ATTN_BBLK", "8")
+    assert _bblk(16, 16, 128, 128, 4) == 8
+    # programmatic force beats env
+    with overrides.forcing("bahdanau_attention", {"bblk": 16}):
+        assert _bblk(16, 16, 128, 128, 4) == 16
+    # flag kill-switch drops the table layer
+    monkeypatch.delenv("PT_ATTN_BBLK")
+    FLAGS.use_tuned_table = False
+    try:
+        assert _bblk(16, 16, 128, 128, 4) == 8  # analytic default
+    finally:
+        FLAGS.use_tuned_table = True
+    assert _bblk(16, 16, 128, 128, 4) == 16
+
+
+def test_flash_and_conv_consult_overrides(tmp_table):
+    """flash_ops._v5e_block_sizes and fused_conv_ops._block_rows
+    consult the registry before their analytic defaults."""
+    import jax.numpy as jnp2
+
+    from paddle_tpu.ops.flash_ops import _v5e_block_sizes
+    from paddle_tpu.ops.fused_conv_ops import _block_rows
+
+    # analytic defaults first
+    bs = _v5e_block_sizes(1024, 1024, jnp2.bfloat16)
+    assert (bs.block_q, bs.block_k) == (512, 512)
+    assert _block_rows(2048, 128, 512, 2) == 1024
+    # tuned table entries take over
+    t = overrides.table()
+    t.put("flash_attention", {"Tq": 1024, "Tk": 1024}, "bfloat16",
+          {"block_q": 256, "block_k": 128})
+    t.put("fused_conv", {"n": 2048, "cin": 128, "cout": 512}, "bfloat16",
+          {"block_rows": 256})
+    bs = _v5e_block_sizes(1024, 1024, jnp2.bfloat16)
+    assert (bs.block_q, bs.block_k) == (256, 128)
+    assert _block_rows(2048, 128, 512, 2) == 256
+    # a stale flash entry (doesn't divide T) is ignored, not fatal
+    t.put("flash_attention", {"Tq": 512, "Tk": 512}, "bfloat16",
+          {"block_q": 768, "block_k": 768})
+    bs = _v5e_block_sizes(512, 512, jnp2.bfloat16)
+    assert (bs.block_q, bs.block_k) == (512, 512)
+    # forced illegal conv block warns and disables the fused path
+    with overrides.forcing("fused_conv", {"block_rows": 12}):
+        with pytest.warns(UserWarning, match="fails eligibility"):
+            assert _block_rows(2048, 128, 512, 2) == 0
+
+
+def test_rnn_dispatch_consults_overrides(tmp_table):
+    """The tuner's {"fused": bool} verdict overrides the measured
+    H-window (but can never force an ineligible shape fused)."""
+    from paddle_tpu.ops.pallas_kernels import gru_supported
+
+    prev = FLAGS.fused_rnn_interpret
+    FLAGS.fused_rnn_interpret = True
+    try:
+        # H=384 sits outside the GRU measured window -> scan by default
+        assert not gru_supported(128, 384, "sigmoid", "tanh", itemsize=2)
+        overrides.table().put("fused_gru", {"B": 128, "H": 384},
+                              "bfloat16", {"fused": True})
+        assert gru_supported(128, 384, "sigmoid", "tanh", itemsize=2)
+        # hard illegality (B % 8) wins over any table verdict
+        overrides.table().put("fused_gru", {"B": 12, "H": 384},
+                              "bfloat16", {"fused": True})
+        assert not gru_supported(12, 384, "sigmoid", "tanh", itemsize=2)
+    finally:
+        FLAGS.fused_rnn_interpret = prev
+
+
+def test_forced_illegal_warns_and_disables(tmp_table):
+    from paddle_tpu.ops.bahdanau_kernels import _bblk
+
+    with overrides.forcing("bahdanau_attention", {"bblk": 3}):
+        with pytest.warns(UserWarning, match="fails eligibility"):
+            assert _bblk(16, 16, 128, 128, 4) == 0
+
+
+def test_stale_table_entry_falls_back_to_analytic(tmp_table):
+    """A shipped table must never break a model: an entry that fails
+    legality at lookup time (schema drift, hand-edit) is ignored."""
+    from paddle_tpu.ops.bahdanau_kernels import _bblk
+
+    params = {"B": 16, "Sp": 16, "A": 128, "C": 128}
+    overrides.table().put("bahdanau_attention", params, "float32",
+                          {"bblk": 3})  # not a legal tile for B=16
+    import warnings as _w
+
+    with _w.catch_warnings():
+        _w.simplefilter("error")  # and it must not warn either
+        assert _bblk(16, 16, 128, 128, 4) == 8
+
+
+def test_env_knob_still_warns_when_illegal(tmp_table, monkeypatch):
+    from paddle_tpu.ops.bahdanau_kernels import _bblk
+
+    monkeypatch.setenv("PT_ATTN_BBLK", "6")
+    with pytest.warns(UserWarning, match="fails eligibility"):
+        assert _bblk(16, 16, 128, 128, 4) == 0
+
+
+def test_fingerprint_reacts_to_every_source(tmp_table, monkeypatch):
+    fp0 = overrides.fingerprint()
+    # forced config
+    overrides.force("bahdanau_attention", {"bblk": 16})
+    fp1 = overrides.fingerprint()
+    assert fp1 != fp0
+    overrides.force("bahdanau_attention", None)
+    assert overrides.fingerprint() == fp0
+    # legacy env knob
+    monkeypatch.setenv("PT_ATTN_BBLK", "8")
+    assert overrides.fingerprint() != fp0
+    monkeypatch.delenv("PT_ATTN_BBLK")
+    # table content
+    overrides.table().put("fused_conv", {"n": 1024, "cin": 128,
+                                         "cout": 128}, "bfloat16",
+                          {"block_rows": 256})
+    assert overrides.fingerprint() != fp0
+    # flag
+    FLAGS.use_tuned_table = False
+    try:
+        fp_off = overrides.fingerprint()
+    finally:
+        FLAGS.use_tuned_table = True
+    assert fp_off not in (fp0, overrides.fingerprint())
+
+
+def test_executor_retraces_on_override_change(tmp_table):
+    """The jit-cache-key contract: flipping a kernel knob re-traces
+    (one new miss) instead of reusing the stale compiled program."""
+    x = pt.layers.data("x", shape=[4])
+    y = pt.layers.fc(x, size=4)
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    feed = {"x": np.zeros((2, 4), np.float32)}
+    exe.run(feed=feed, fetch_list=[y])
+    misses0 = exe.cache_stats["misses"]
+    exe.run(feed=feed, fetch_list=[y])
+    assert exe.cache_stats["misses"] == misses0  # warm hit
+    overrides.force("bahdanau_attention", {"bblk": 4})
+    exe.run(feed=feed, fetch_list=[y])
+    assert exe.cache_stats["misses"] == misses0 + 1  # knob -> re-trace
+
+
+# --------------------------------------------------------- harness ------
+def test_harness_refuses_to_time_off_tpu():
+    assert jax.default_backend() != "tpu"  # the suite's invariant
+    with pytest.raises(harness.TuningUnavailable):
+        harness.ensure_timeable()
+    with pytest.raises(harness.TuningUnavailable):
+        harness.tune_case("bahdanau", {"B": 8, "Sp": 16, "A": 128,
+                                       "C": 128}, "float32")
+
+
+def test_harness_loop_mechanics_interpret(tmp_table):
+    """The measurement loop itself (candidate sweep, numeric
+    cross-check, table write) exercised in interpret mode with the TPU
+    requirement waived — production entry points keep require_tpu."""
+    t = overrides.table()
+    rep = harness.tune_case("bahdanau", {"B": 16, "Sp": 16, "A": 128,
+                                         "C": 128}, "float32",
+                            table=t, iters=2, warmup=1, require_tpu=False)
+    assert {r["config"]["bblk"] for r in rep["rows"]} == {8, 16}
+    assert all(r["numerics_ok"] for r in rep["rows"])
+    assert rep["best"] in [r["config"] for r in rep["rows"]]
+    assert rep["default"] == {"bblk": 8}
+    # the winner landed in the table under the runtime's lookup key
+    assert t.get("bahdanau_attention",
+                 {"B": 16, "Sp": 16, "A": 128, "C": 128},
+                 "float32") == rep["best"]
+
+
+def test_stat_median_of_k():
+    from paddle_tpu.profiler import StatSet
+
+    s = StatSet(keep_samples=5)
+    for v in (0.5, 0.01, 0.02, 0.03, 100.0):
+        s.get("t").add(v)
+    assert s.get("t").median == 0.03  # outliers shrugged off
+    # default StatSet keeps the zero-overhead aggregate behavior
+    s2 = StatSet()
+    s2.get("t").add(1.0)
+    assert s2.get("t").samples is None
+    assert s2.get("t").median == 1.0  # falls back to avg
+
+
+# -------------------------------------------------- golden numerics ------
+@pytest.fixture
+def interpret_flag():
+    FLAGS.fused_attention_interpret = True
+    yield
+    FLAGS.fused_attention_interpret = False
+
+
+def _decoder_inputs(B=16, S=10, T=4, E=128, C=128, A=128, H=128):
+    rng = np.random.RandomState(7)
+    f32 = jnp.float32
+    enc_b = jnp.asarray(rng.randn(B, S, C) * 0.3, f32)
+    enc_proj = jnp.asarray(rng.randn(B, S, A) * 0.3, f32)
+    lens = rng.randint(S // 2, S + 1, (B,))
+    enc_mask = jnp.asarray(np.arange(S)[None, :] < lens[:, None])
+    trg_b = jnp.asarray(rng.randn(T, B, E) * 0.3, f32)
+    trg_mask = jnp.ones((T, B), f32)
+    h0 = jnp.asarray(rng.randn(B, H) * 0.1, f32)
+    wa_dec = jnp.asarray(rng.randn(H, A) / np.sqrt(H), f32)
+    v_att = jnp.asarray(rng.randn(A) / np.sqrt(A), f32)
+    wx = jnp.asarray(rng.randn(E + C, 3 * H) / np.sqrt(E + C), f32)
+    wh = jnp.asarray(rng.randn(H, 3 * H) / np.sqrt(H), f32)
+    bias = jnp.asarray(rng.randn(3 * H) * 0.05, f32)
+    return (enc_b, enc_proj, enc_mask, trg_b, trg_mask, h0, wa_dec,
+            v_att, wx, wh, bias)
+
+
+def test_forced_tuned_config_bit_identical(interpret_flag, tmp_table):
+    """Golden numerics: a tuned tile (bblk=16) partitions the batch
+    differently but must reproduce the analytic default (bblk=8)
+    BIT-FOR-BIT for the forward and every per-row gradient — per-row
+    math is tile-invariant. The one principled exception is d(v): its
+    reduction crosses batch tiles, so the tile size changes the f32
+    summation ORDER (2 partial sums at bblk=8 vs 1 at bblk=16) — that
+    gradient is pinned to f32-rounding tightness instead. This is the
+    guarantee that lets a tuned table ship without a numerics
+    qualification run."""
+    from paddle_tpu.ops.bahdanau_kernels import (_bblk,
+                                                 fused_attention_decoder)
+
+    args = _decoder_inputs()
+
+    def loss(enc_proj, v_att):
+        a = list(args)
+        a[1], a[7] = enc_proj, v_att
+        return jnp.sum(fused_attention_decoder(*a) ** 2)
+
+    grad_fn = jax.grad(loss, argnums=(0, 1))
+
+    assert _bblk(16, 16, 128, 128, 4) == 8  # analytic default engaged
+    h_default = np.asarray(fused_attention_decoder(*args))
+    g_default = [np.asarray(g) for g in grad_fn(args[1], args[7])]
+
+    with overrides.forcing("bahdanau_attention", {"bblk": 16}):
+        assert _bblk(16, 16, 128, 128, 4) == 16  # tuned tile engaged
+        h_tuned = np.asarray(fused_attention_decoder(*args))
+        g_tuned = [np.asarray(g) for g in grad_fn(args[1], args[7])]
+
+    np.testing.assert_array_equal(h_tuned, h_default)
+    np.testing.assert_array_equal(g_tuned[0], g_default[0])  # d(enc_proj)
+    np.testing.assert_allclose(g_tuned[1], g_default[1],     # d(v)
+                               rtol=1e-5, atol=1e-6)
+
+
+# ------------------------------------------------------- io/serving ------
+def _save_tiny_model(tmp_path):
+    x = pt.layers.data("x", shape=[4])
+    y = pt.layers.fc(x, size=2, act="softmax")
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    model_dir = str(tmp_path / "model")
+    pt.io.save_inference_model(model_dir, ["x"], [y])
+    return model_dir
+
+
+def test_meta_json_records_tuning_provenance(tmp_path, tmp_table):
+    model_dir = _save_tiny_model(tmp_path)
+    with open(os.path.join(model_dir, "meta.json")) as f:
+        meta = json.load(f)
+    assert meta["tuning"]["device_kind"] == tcache.device_kind()
+    assert meta["tuning"]["table_fingerprint"] == \
+        overrides.table().fingerprint()
+
+
+def test_serving_warmup_warns_on_stale_table(tmp_path, tmp_table):
+    from paddle_tpu.serving import ServingEngine
+
+    model_dir = _save_tiny_model(tmp_path)
+    engine = ServingEngine(model_dir)
+    # provenance matches (same process, same table): no warning
+    import warnings as _w
+
+    with _w.catch_warnings():
+        _w.simplefilter("error")
+        assert engine.check_tuned_table()
+    # the serving host's table changes (retune without re-export):
+    overrides.table().put("fused_conv", {"n": 512, "cin": 128,
+                                         "cout": 128}, "bfloat16",
+                          {"block_rows": 128})
+    with pytest.warns(UserWarning, match="stale"):
+        assert not engine.check_tuned_table()
+    # pre-tuner artifact (no provenance recorded): silently fine
+    engine.tuning_meta = None
+    with _w.catch_warnings():
+        _w.simplefilter("error")
+        assert engine.check_tuned_table()
+
+
+# ------------------------------------------------------ model sweep ------
+def test_cases_from_program_finds_flash_sites():
+    q = pt.layers.data("q", shape=[1024, 256])
+    k = pt.layers.data("k", shape=[1024, 256])
+    v = pt.layers.data("v", shape=[1024, 256])
+    pt.layers.multi_head_attention(q, k, v, num_heads=2, causal=False)
+    sites = space.cases_from_program()
+    flash = [s for s in sites if s["family"] == "flash_attention"]
+    assert flash and flash[0]["params"] == {"Tq": 1024, "Tk": 1024}
