@@ -34,6 +34,7 @@ from . import layers  # noqa: F401
 from . import networks  # noqa: F401
 from . import optimizer  # noqa: F401
 from . import regularizer  # noqa: F401
+from . import resilience  # noqa: F401
 from . import serving  # noqa: F401
 from . import tune  # noqa: F401
 from .core import (  # noqa: F401
@@ -66,11 +67,12 @@ from .version import full_version as __version__  # noqa: F401
 
 
 def reset():
-    """Fresh default programs + scope + tune overrides (test isolation
-    helper)."""
+    """Fresh default programs + scope + tune overrides + fault-injection
+    registry (test isolation helper)."""
     reset_default_programs()
     reset_global_scope()
     tune.overrides.reset()
+    resilience.faults.reset()
 
 
 def init(seed: int = 0, distributed: bool = False, **flag_overrides):

@@ -131,13 +131,21 @@ def _cmd_train(argv) -> int:
             ms = ", ".join(f"{k}={v:.5g}" for k, v in event.metrics.items())
             print(f"Pass {event.pass_id} done: {ms}")
 
-    metrics = trainer.train(
-        model["reader"],
-        num_passes=num_passes,
-        feed_order=model.get("feed_order"),
-        fetch_metrics=model.get("metrics"),
-        event_handler=log_handler,
-    )
+    from .resilience import PREEMPT_EXIT_CODE, PreemptedError
+
+    try:
+        metrics = trainer.train(
+            model["reader"],
+            num_passes=num_passes,
+            feed_order=model.get("feed_order"),
+            fetch_metrics=model.get("metrics"),
+            event_handler=log_handler,
+        )
+    except PreemptedError as e:
+        # EX_TEMPFAIL: the scheduler should reschedule this job; a rerun
+        # with the same --save_dir resumes from the emergency checkpoint
+        print(f"preempted: {e}", flush=True)
+        return PREEMPT_EXIT_CODE
     print("final:", {k: round(float(v), 6) for k, v in metrics.items()})
     return 0
 

@@ -63,34 +63,56 @@ def md5file(fname: str) -> str:
     return h.hexdigest()
 
 
+# socket timeout handed to urlopen: a stalled mirror must fail the
+# attempt in seconds (and spend one of the 3 retries), never hang the
+# job forever on a dead recv(). Overridable per-host via env.
+DOWNLOAD_TIMEOUT_S = float(os.environ.get("PT_DOWNLOAD_TIMEOUT", "30"))
+
+
 def download(url: str, module_name: str, md5sum: str,
-             save_name: Optional[str] = None) -> str:
+             save_name: Optional[str] = None,
+             timeout: Optional[float] = None) -> str:
     """Return the path of the cached, checksum-verified file; fetch it if
     missing. Reference: common.py:65."""
+    import socket
+
     dirname = os.path.join(data_home(), module_name)
     os.makedirs(dirname, exist_ok=True)
     filename = os.path.join(
         dirname, save_name if save_name else url.split("/")[-1]
     )
+    timeout = DOWNLOAD_TIMEOUT_S if timeout is None else float(timeout)
 
-    retry, retry_limit = 0, 3
+    retry, retry_limit, timeouts = 0, 3, 0
     while not (os.path.exists(filename) and md5file(filename) == md5sum):
         if retry == retry_limit:
+            timed_out = (f" ({timeouts} of them stalled past the "
+                         f"{timeout:g}s socket timeout)" if timeouts else "")
             raise RuntimeError(
-                f"cannot download {url} within {retry_limit} retries; "
-                f"if this host has no egress, pre-seed the cache file at "
-                f"{filename} (md5 {md5sum})"
+                f"cannot download {url} within {retry_limit} retries"
+                f"{timed_out}; if this host has no egress, pre-seed the "
+                f"cache file at {filename} (md5 {md5sum})"
             )
         retry += 1
         tmp = filename + ".part"
         try:
             import urllib.request
 
-            with urllib.request.urlopen(url, timeout=30) as r, \
+            with urllib.request.urlopen(url, timeout=timeout) as r, \
                     open(tmp, "wb") as f:
                 shutil.copyfileobj(r, f)
             os.replace(tmp, filename)
-        except Exception:  # noqa: BLE001 — retry loop decides fatality
+        except (socket.timeout, TimeoutError):
+            # a stall counts against the same retry budget as any other
+            # failure, but is reported distinctly — "mirror is slow" and
+            # "mirror is wrong" need different fixes
+            timeouts += 1
+        except Exception as e:  # noqa: BLE001 — retry loop decides fatality
+            # connect-phase timeouts surface wrapped in URLError.reason
+            if isinstance(getattr(e, "reason", None),
+                          (socket.timeout, TimeoutError)):
+                timeouts += 1
+        finally:
             if os.path.exists(tmp):
                 os.remove(tmp)
     return filename

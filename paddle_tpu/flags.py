@@ -111,6 +111,12 @@ define_flag("check_nan_inf", False,
             "after each executor run, verify all persistable outputs are "
             "finite (reference: FLAGS_check_nan_inf, fluid executor.cc:60)")
 define_flag("seed", 0, "global random seed (0 = nondeterministic)")
+define_flag("step_guard", False,
+            "trainer: enable the resilience.StepGuard default policy — "
+            "skip non-finite steps, roll back to the last checkpoint "
+            "after 3 consecutive, reduced-LR cool-down (the production "
+            "counterpart of check_nan_inf's debug abort; README 'Fault "
+            "tolerance')")
 define_flag("log_period", 100, "trainer: log every N batches")
 define_flag("show_param_stats_period", 0,
             "trainer: dump per-parameter value/gradient stats every N "

@@ -21,11 +21,14 @@ deliberately single-file so a checkpoint is also the deployment artifact
 
 from __future__ import annotations
 
+import hashlib
 import json
 import os
 import re
 import shutil
 import tempfile
+import warnings
+import zipfile
 from typing import Any, Dict, List, Optional, Sequence
 
 import numpy as np
@@ -33,8 +36,10 @@ import numpy as np
 from .core.executor import Executor, Scope, global_scope
 from .core.lod import LoDArray
 from .core.program import Program, Variable, default_main_program
+from .resilience import faults
 
 __all__ = [
+    "CheckpointCorruptError",
     "save_vars",
     "save_params",
     "save_persistables",
@@ -47,6 +52,7 @@ __all__ = [
     "load_checkpoint",
     "clean_checkpoint",
     "get_latest_checkpoint_serial",
+    "verify_checkpoint",
     "save_sharded_checkpoint",
     "load_sharded_checkpoint",
 ]
@@ -55,6 +61,36 @@ PARAMS_FILE = "params.npz"
 PROGRAM_FILE = "program.json"
 META_FILE = "meta.json"
 CHECKPOINT_PREFIX = "checkpoint"
+
+
+class CheckpointCorruptError(RuntimeError):
+    """A checkpoint's payload does not match the integrity record in its
+    meta (or the payload is unreadable)."""
+
+
+def _sha256_file(path: str) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def _write_json_atomic(path: str, obj) -> None:
+    """tmp + os.replace so a preempted writer can never leave a torn
+    JSON file (the same discipline save_vars applies to the npz)."""
+    d = os.path.dirname(os.path.abspath(path))
+    fd, tmp = tempfile.mkstemp(dir=d, suffix=".tmp")
+    try:
+        with os.fdopen(fd, "w") as f:
+            json.dump(obj, f)
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
 
 
 # ---------------------------------------------------------------------------
@@ -85,6 +121,13 @@ def save_vars(
     try:
         with open(tmp, "wb") as f:
             np.savez(f, **arrays)
+        # fault point: "raise" simulates a failed write (tmp removed,
+        # previous file intact); "corrupt" publishes a torn npz — the
+        # scenario the loader's quarantine-and-fall-back path must
+        # survive even when a meta marker lands after it
+        if faults.fire("ckpt.write", path=path) == "corrupt":
+            with open(tmp, "r+b") as f:
+                f.truncate(max(os.path.getsize(tmp) // 2, 1))
         os.replace(tmp, path)
     finally:
         if os.path.exists(tmp):
@@ -100,14 +143,20 @@ def load_vars(
 ) -> List[str]:
     scope = scope or global_scope()
     path = os.path.join(dirname, filename)
-    loaded = []
+    # materialize every array BEFORE touching the scope: decompression
+    # forces truncation/corruption to surface here, so a bad file can
+    # never leave the scope half-updated
     with np.load(path) as data:
         names = list(data.files) if var_names is None else list(var_names)
+        arrays = {}
         for n in names:
             if n not in data:
                 raise KeyError(f"variable {n!r} not found in {path}")
-            scope.set(n, data[n])
-            loaded.append(n)
+            arrays[n] = data[n]
+    loaded = []
+    for n, a in arrays.items():
+        scope.set(n, a)
+        loaded.append(n)
     return loaded
 
 
@@ -273,18 +322,88 @@ def _serial_dir(checkpoint_dir: str, serial: int) -> str:
     return os.path.join(checkpoint_dir, f"{CHECKPOINT_PREFIX}_{serial}")
 
 
-def get_latest_checkpoint_serial(checkpoint_dir: str) -> int:
-    """Largest *complete* (meta present) checkpoint serial, or -1."""
+def _complete_serials(checkpoint_dir: str) -> List[int]:
+    """Ascending serials whose completion marker (meta) is present.
+    Quarantined `checkpoint_N.corrupt` dirs never match."""
     if not os.path.isdir(checkpoint_dir):
-        return -1
-    best = -1
+        return []
+    out = []
     for name in os.listdir(checkpoint_dir):
         m = re.fullmatch(rf"{CHECKPOINT_PREFIX}_(\d+)", name)
         if m and os.path.exists(
             os.path.join(checkpoint_dir, name, META_FILE)
         ):
-            best = max(best, int(m.group(1)))
-    return best
+            out.append(int(m.group(1)))
+    return sorted(out)
+
+
+def get_latest_checkpoint_serial(checkpoint_dir: str,
+                                 verify: bool = False) -> int:
+    """Largest *complete* (meta present) checkpoint serial, or -1.
+    verify=True additionally demands the payload match the integrity
+    hashes in meta, returning the newest serial that would actually
+    load (read-only: nothing is quarantined — load_checkpoint does
+    that when it takes the fallback for real)."""
+    serials = _complete_serials(checkpoint_dir)
+    if not verify:
+        return serials[-1] if serials else -1
+    for serial in reversed(serials):
+        try:
+            verify_checkpoint(_serial_dir(checkpoint_dir, serial))
+            return serial
+        except CheckpointCorruptError:
+            continue
+    return -1
+
+
+def verify_checkpoint(dirname: str) -> None:
+    """Raise CheckpointCorruptError unless the directory's meta parses
+    and every payload file hashed into it (`integrity`) is present and
+    matches. Pre-hardening checkpoints (no integrity record) pass —
+    their corruption is still caught at load time by the materialize-
+    before-commit read."""
+    meta_path = os.path.join(dirname, META_FILE)
+    try:
+        with open(meta_path) as f:
+            meta = json.load(f)
+    except (OSError, ValueError) as e:
+        raise CheckpointCorruptError(
+            f"{dirname}: unreadable meta ({e})") from e
+    integrity = meta.get("integrity")
+    if not isinstance(integrity, dict):
+        return
+    for fname, want in sorted(integrity.items()):
+        path = os.path.join(dirname, fname)
+        if not os.path.exists(path):
+            raise CheckpointCorruptError(
+                f"{dirname}: payload {fname} missing")
+        got = _sha256_file(path)
+        if got != want:
+            raise CheckpointCorruptError(
+                f"{dirname}: payload {fname} sha256 {got[:12]}… does not "
+                f"match the recorded {str(want)[:12]}…")
+
+
+def _quarantine_dir(dirname: str) -> str:
+    """Move a corrupt checkpoint aside (same pattern as tune/cache.py's
+    corrupt-table quarantine) so the serial scan never sees it again
+    but a human still can."""
+    q = dirname + ".corrupt"
+    i = 1
+    while os.path.exists(q):
+        q = f"{dirname}.corrupt.{i}"
+        i += 1
+    os.replace(dirname, q)
+    return q
+
+
+def _payload_files(dirname: str) -> List[str]:
+    """Checkpoint payload files subject to integrity hashing."""
+    return sorted(
+        n for n in os.listdir(dirname)
+        if n == PARAMS_FILE or n == SHARDED_META
+        or re.fullmatch(r"shards_p\d+\.npz", n)
+    )
 
 
 def save_checkpoint(
@@ -320,35 +439,59 @@ def save_checkpoint(
         os.makedirs(d, exist_ok=True)
         save_sharded_checkpoint(d, main_program, scope)  # barriers inside
         # completion marker: chief only, AFTER the fold, then a barrier so
-        # no process returns before the checkpoint is actually loadable
+        # no process returns before the checkpoint is actually loadable.
+        # The meta records a sha256 per payload file (every shard is
+        # complete and visible to the chief past the fold barrier) so the
+        # loader can tell a bit-rotted shard from a good one.
         if chief:
-            with open(os.path.join(d, META_FILE), "w") as f:
-                json.dump(
-                    {"serial": serial, "trainer_args": trainer_args or {}}, f
-                )
+            faults.fire("ckpt.meta", serial=serial)
+            _write_json_atomic(
+                os.path.join(d, META_FILE),
+                {"serial": serial, "trainer_args": trainer_args or {},
+                 "integrity": {n: _sha256_file(os.path.join(d, n))
+                               for n in _payload_files(d)}},
+            )
         if jax.process_count() > 1:
             from jax.experimental import multihost_utils
 
             multihost_utils.sync_global_devices("ptpu_ckpt_meta")
         if not chief:
+            # retention runs on the chief only: a peer sweeping on its
+            # own filesystem view could delete a serial the chief still
+            # considers in flight
             return serial
     else:
         d = _serial_dir(checkpoint_dir, serial)
         os.makedirs(d, exist_ok=True)
         save_persistables(d, main_program, scope)
-        # meta written last: its presence marks the checkpoint complete
-        with open(os.path.join(d, META_FILE), "w") as f:
-            json.dump(
-                {"serial": serial, "trainer_args": trainer_args or {}}, f
-            )
-    serials = sorted(
-        int(m.group(1))
-        for name in os.listdir(checkpoint_dir)
-        if (m := re.fullmatch(rf"{CHECKPOINT_PREFIX}_(\d+)", name))
-    )
-    for s in serials[:-max_num_checkpoints]:
+        # meta written last: its presence marks the checkpoint complete,
+        # and it carries the payload hashes so load can verify integrity
+        faults.fire("ckpt.meta", serial=serial)
+        _write_json_atomic(
+            os.path.join(d, META_FILE),
+            {"serial": serial, "trainer_args": trainer_args or {},
+             "integrity": {n: _sha256_file(os.path.join(d, n))
+                           for n in _payload_files(d)}},
+        )
+    # retention sweeps only COMPLETE serials (meta present): an
+    # incomplete directory may belong to a save another process is
+    # still writing — deleting it under them corrupts that save
+    for s in _complete_serials(checkpoint_dir)[:-max_num_checkpoints]:
         shutil.rmtree(_serial_dir(checkpoint_dir, s), ignore_errors=True)
     return serial
+
+
+# errors that mean "this checkpoint is damaged, try the previous one"
+# rather than "the caller made a mistake": integrity mismatches, torn
+# zip containers, short reads, members missing after truncation
+_RECOVERABLE_LOAD_ERRORS = (
+    CheckpointCorruptError,
+    OSError,
+    ValueError,  # covers json.JSONDecodeError and npz parse errors
+    KeyError,
+    EOFError,
+    zipfile.BadZipFile,
+)
 
 
 def load_checkpoint(
@@ -356,17 +499,37 @@ def load_checkpoint(
     main_program: Optional[Program] = None,
     scope: Optional[Scope] = None,
 ) -> Dict[str, Any]:
-    """Restore the newest complete checkpoint; returns its trainer_args."""
-    serial = get_latest_checkpoint_serial(checkpoint_dir)
-    if serial < 0:
-        raise FileNotFoundError(f"no checkpoint under {checkpoint_dir}")
-    d = _serial_dir(checkpoint_dir, serial)
-    if os.path.exists(os.path.join(d, SHARDED_META)):
-        load_sharded_checkpoint(d, main_program, scope)
-    else:
-        load_persistables(d, main_program, scope)
-    with open(os.path.join(d, META_FILE)) as f:
-        return json.load(f)["trainer_args"]
+    """Restore the newest VALID checkpoint; returns its trainer_args.
+
+    A serial whose integrity hashes mismatch — or whose payload fails
+    to deserialize despite the meta marker being present (torn write,
+    bit rot) — is quarantined to `<dir>.corrupt` and the previous
+    serial is tried, so one damaged checkpoint costs one checkpoint
+    interval, never the run."""
+    quarantined = 0
+    while True:
+        serial = get_latest_checkpoint_serial(checkpoint_dir)
+        if serial < 0:
+            extra = (f" ({quarantined} corrupt serial(s) quarantined)"
+                     if quarantined else "")
+            raise FileNotFoundError(
+                f"no valid checkpoint under {checkpoint_dir}{extra}")
+        d = _serial_dir(checkpoint_dir, serial)
+        try:
+            verify_checkpoint(d)
+            if os.path.exists(os.path.join(d, SHARDED_META)):
+                load_sharded_checkpoint(d, main_program, scope)
+            else:
+                load_persistables(d, main_program, scope)
+            with open(os.path.join(d, META_FILE)) as f:
+                return json.load(f)["trainer_args"]
+        except _RECOVERABLE_LOAD_ERRORS as e:
+            quarantined += 1
+            q = _quarantine_dir(d)
+            warnings.warn(
+                f"checkpoint {d} is corrupt ({type(e).__name__}: {e}); "
+                f"quarantined to {q}, falling back to the previous "
+                "serial", stacklevel=2)
 
 
 def clean_checkpoint(checkpoint_dir: str) -> None:
@@ -461,6 +624,11 @@ def save_sharded_checkpoint(
     try:
         with open(tmp, "wb") as f:
             np.savez(f, **local)
+        # same fault point as the single-file path: shard writes are
+        # exactly where a preempted/bit-rotted save manifests at scale
+        if faults.fire("ckpt.write", shard=pid) == "corrupt":
+            with open(tmp, "r+b") as f:
+                f.truncate(max(os.path.getsize(tmp) // 2, 1))
         os.replace(tmp, os.path.join(dirname, f"shards_p{pid}.npz"))
     finally:
         if os.path.exists(tmp):
@@ -507,8 +675,7 @@ def _fold_sharded_manifests(dirname: str, chief_meta: Dict[str, Any]) -> None:
                 mine = merged["vars"].setdefault(var, info)
                 if mine is not info:
                     mine["shards"].extend(info["shards"])
-    with open(os.path.join(dirname, SHARDED_META), "w") as f:
-        json.dump(merged, f)
+    _write_json_atomic(os.path.join(dirname, SHARDED_META), merged)
 
 
 def load_sharded_checkpoint(
@@ -536,11 +703,14 @@ def load_sharded_checkpoint(
     files = {
         p: np.load(os.path.join(dirname, f"shards_p{p}.npz")) for p in procs
     }
-    loaded = []
+    # stage everything on host BEFORE committing to the scope: a corrupt
+    # shard file surfaces during assembly and leaves the scope untouched
+    # (load_checkpoint then falls back to the previous serial)
+    staging: Dict[str, np.ndarray] = {}
     try:
         for var, info in meta["vars"].items():
             if info["kind"] == "replicated":
-                scope.set(var, files[0][f"{var}::r"])
+                staging[var] = files[0][f"{var}::r"]
             else:
                 out = np.zeros(info["shape"], np.dtype(info["dtype"]))
                 covered = np.zeros(info["shape"], bool)
@@ -554,9 +724,12 @@ def load_sharded_checkpoint(
                         f"({int((~covered).sum())} of {covered.size} "
                         "elements) — incomplete save?"
                     )
-                scope.set(var, out)
-            loaded.append(var)
+                staging[var] = out
     finally:
         for f in files.values():
             f.close()
+    loaded = []
+    for var, val in staging.items():
+        scope.set(var, val)
+        loaded.append(var)
     return loaded

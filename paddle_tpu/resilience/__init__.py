@@ -1,0 +1,67 @@
+"""paddle_tpu.resilience: fault injection + fault-tolerant training.
+
+The reference framework's distributed story is built on surviving
+failure — a fault-tolerant Go master with etcd-backed pserver
+checkpointing (SURVEY §5.3/§5.4). This package is that posture applied
+to the TPU rebuild, in five pieces:
+
+- `faults`    — deterministic fault-injection registry (named points
+                threaded through io/trainer/serving; no-ops when
+                disarmed) so recovery paths are PROVABLE in CI;
+- checkpoint hardening lives in `io.py` (sha256 integrity in meta,
+  atomic writes, newest-VALID-serial fallback with corrupt-dir
+  quarantine);
+- `guard`     — StepGuard: skip non-finite steps, roll back to the last
+                checkpoint after K consecutive, reduced-LR cool-down;
+- preemption  — SIGTERM/SIGINT → finish the batch → emergency
+                checkpoint → PreemptedError / exit code 75 (EX_TEMPFAIL:
+                "transient, reschedule me") in Trainer.train / the CLI;
+- `retry`     — RetryReader (backoff + jitter + budget) and
+  `breaker`   — per-model serving CircuitBreaker (closed → open →
+                half-open probe), surfaced in /healthz and /metrics.
+"""
+
+from . import breaker  # noqa: F401
+from . import faults  # noqa: F401
+from . import guard  # noqa: F401
+from . import retry  # noqa: F401
+from .breaker import CircuitBreaker, CircuitOpenError  # noqa: F401
+from .faults import InjectedFault  # noqa: F401
+from .guard import NonFiniteError, StepGuard  # noqa: F401
+from .retry import RetryExhausted, RetryReader  # noqa: F401
+
+__all__ = [
+    "CircuitBreaker",
+    "CircuitOpenError",
+    "InjectedFault",
+    "NonFiniteError",
+    "PREEMPT_EXIT_CODE",
+    "PreemptedError",
+    "RetryExhausted",
+    "RetryReader",
+    "StepGuard",
+    "breaker",
+    "faults",
+    "guard",
+    "retry",
+]
+
+# BSD sysexits EX_TEMPFAIL: the conventional "transient failure, retry
+# the job" status — what a cluster scheduler should treat as
+# reschedule-don't-page. The CLI train command exits with this after a
+# SIGTERM/SIGINT-triggered emergency checkpoint.
+PREEMPT_EXIT_CODE = 75
+
+
+class PreemptedError(RuntimeError):
+    """Training was interrupted by SIGTERM/SIGINT; the current batch was
+    finished and (when checkpointing is configured) an emergency
+    checkpoint was saved before raising."""
+
+    def __init__(self, signame: str, checkpointed: bool):
+        super().__init__(
+            f"training preempted by {signame}"
+            + ("; emergency checkpoint saved" if checkpointed
+               else "; no checkpoint_config — progress NOT saved"))
+        self.signame = signame
+        self.checkpointed = checkpointed

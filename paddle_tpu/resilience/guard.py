@@ -1,0 +1,150 @@
+"""StepGuard: non-finite loss/grad containment for the training loop.
+
+Reference lineage: FLAGS_check_nan_inf (fluid executor.cc:60-72) *aborts*
+on the first non-finite value — correct for debugging, wrong for a
+multi-day production run where one overflowed batch should cost one
+batch, not the job. The guard implements the production policy:
+
+1. every step's loss (and fetched grads, when the stats cadence fetched
+   them) is checked for finiteness;
+2. a non-finite step is SKIPPED: its cost never enters the pass stats,
+   and — critically — the step-interval checkpoint cadence is suppressed
+   so poisoned parameters can never become the "last good checkpoint";
+3. after `max_consecutive` bad steps in a row the parameters are assumed
+   poisoned (one NaN update contaminates everything downstream) and the
+   Trainer rolls back to the newest valid checkpoint, then runs a
+   `cooldown_steps`-long window at `lr_factor`× learning rate before
+   restoring it — the standard loss-spike recovery recipe;
+4. more than `max_rollbacks` rollbacks means the run is not recovering:
+   raise NonFiniteError rather than loop forever.
+
+The LR cool-down scales the persistable `<optimizer>.lr` scope scalars
+(optimizer/__init__.py `_lr_var`); runs driven by an LRSchedule compute
+their rate from the step counter inside the program and are rolled back
+but not re-scaled (documented limitation — the rollback itself is the
+load-bearing part).
+
+The guard is plain host-side numpy over values the trainer already
+fetched — no extra device work, so its per-step overhead is noise
+(PERF.md "StepGuard overhead").
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+__all__ = ["NonFiniteError", "StepGuard"]
+
+log = logging.getLogger("paddle_tpu.resilience")
+
+
+class NonFiniteError(RuntimeError):
+    """Training produced non-finite values the guard could not recover
+    from (no checkpoint to roll back to, or the rollback budget is
+    exhausted)."""
+
+
+class StepGuard:
+    def __init__(
+        self,
+        max_consecutive: int = 3,
+        cooldown_steps: int = 20,
+        lr_factor: float = 0.1,
+        max_rollbacks: int = 3,
+    ):
+        if max_consecutive < 1:
+            raise ValueError("max_consecutive must be >= 1")
+        if not (0.0 < lr_factor <= 1.0):
+            raise ValueError("lr_factor must be in (0, 1]")
+        self.max_consecutive = max_consecutive
+        self.cooldown_steps = cooldown_steps
+        self.lr_factor = lr_factor
+        self.max_rollbacks = max_rollbacks
+        self.bad_streak = 0
+        self.skipped = 0
+        self.rollbacks = 0
+        self.cooldown_left = 0
+        self._saved_lr: Dict[str, np.ndarray] = {}
+
+    # -- per-step hook (called by Trainer) -------------------------------
+    def observe(self, cost: float, grads: Optional[Dict[str, Any]] = None,
+                scope=None) -> bool:
+        """Record one step's outcome. Returns True for a finite (good)
+        step; False means the step must be skipped (no stats, no
+        checkpoint). Ticks the LR cool-down on good steps."""
+        bad = not np.isfinite(cost)
+        if not bad and grads:
+            bad = any(
+                not bool(np.isfinite(np.asarray(g)).all())
+                for g in grads.values()
+            )
+        if bad:
+            self.bad_streak += 1
+            self.skipped += 1
+            log.warning(
+                "StepGuard: non-finite step skipped (cost=%r, streak %d/%d)",
+                cost, self.bad_streak, self.max_consecutive)
+            return False
+        self.bad_streak = 0
+        if self.cooldown_left > 0:
+            self.cooldown_left -= 1
+            if self.cooldown_left == 0 and scope is not None:
+                self._restore_lr(scope)
+        return True
+
+    def wants_rollback(self) -> bool:
+        return self.bad_streak >= self.max_consecutive
+
+    def after_rollback(self, program, scope) -> None:
+        """Called by the Trainer once the checkpoint reload is done:
+        spend one rollback from the budget, start the reduced-LR
+        cool-down window."""
+        self.rollbacks += 1
+        if self.rollbacks > self.max_rollbacks:
+            raise NonFiniteError(
+                f"StepGuard: {self.rollbacks} rollbacks without recovery "
+                f"(budget {self.max_rollbacks}) — training is not "
+                "converging past the non-finite region")
+        self.bad_streak = 0
+        self.cooldown_left = self.cooldown_steps
+        self._scale_lr(program, scope)
+        log.warning(
+            "StepGuard: rolled back to last checkpoint (rollback %d/%d); "
+            "LR x%g for %d steps", self.rollbacks, self.max_rollbacks,
+            self.lr_factor, self.cooldown_steps)
+
+    # -- LR cool-down ----------------------------------------------------
+    def _lr_names(self, program, scope):
+        return [
+            v.name for v in program.persistables()
+            if v.name.endswith(".lr") and scope.has(v.name)
+        ]
+
+    def _scale_lr(self, program, scope) -> None:
+        # the checkpoint reload just restored the original rates, so the
+        # freshly loaded values ARE the originals to return to
+        self._saved_lr = {}
+        for name in self._lr_names(program, scope):
+            orig = np.asarray(scope.get(name))
+            self._saved_lr[name] = orig
+            scope.set(name, (orig * self.lr_factor).astype(orig.dtype))
+
+    def _restore_lr(self, scope) -> None:
+        for name, orig in self._saved_lr.items():
+            if scope.has(name):
+                scope.set(name, orig)
+        if self._saved_lr:
+            log.info("StepGuard: cool-down over, LR restored")
+        self._saved_lr = {}
+
+    # -- accounting ------------------------------------------------------
+    def stats(self) -> Dict[str, int]:
+        return {
+            "skipped": self.skipped,
+            "rollbacks": self.rollbacks,
+            "bad_streak": self.bad_streak,
+            "cooldown_left": self.cooldown_left,
+        }

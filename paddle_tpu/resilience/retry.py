@@ -1,0 +1,108 @@
+"""RetryReader: transient-failure tolerance for the data path.
+
+Reference lineage: the v2 dataset layer retries downloads 3 times
+(dataset/common.py) but a *reader* that throws mid-pass — a flaky NFS
+mount, a recordio shard on a rebooting node, an injected
+`reader.next` fault — kills the whole training pass. The Go master's
+answer is task re-dispatch with failure budgets (go/master: a timed-out
+shard goes back in the todo queue, `MaxTaskFailures` caps it); this is
+the single-process analogue: replay the reader, skip what was already
+delivered, with exponential backoff + jitter and a bounded attempt
+budget.
+
+Semantics:
+- the wrapped reader must be re-creatable and deterministic (the same
+  contract mid-pass checkpoint resume already relies on,
+  trainer.py `_resume_batch`): after a failure the reader is re-created
+  and the first `delivered` samples are skipped;
+- the retry budget is per-pass and total (`max_retries`), not
+  per-sample — a reader failing every few samples exhausts the budget
+  instead of limping forever;
+- backoff is exponential from `base_delay_s` capped at `max_delay_s`,
+  with seeded multiplicative jitter (so co-scheduled workers don't
+  retry in lockstep, yet tests are deterministic);
+- every retry is accounted in the profiler StatSet under
+  "resilience/reader_retry" (count = retries, total = seconds slept)
+  next to the serving timers, so /metrics and print_all_status() both
+  see data-path flakiness.
+"""
+
+from __future__ import annotations
+
+import random
+import time
+from typing import Callable, Optional, Tuple, Type
+
+from .. import profiler
+from . import faults
+
+__all__ = ["RetryExhausted", "RetryReader"]
+
+
+class RetryExhausted(RuntimeError):
+    """The reader kept failing past the retry budget."""
+
+
+class RetryReader:
+    """Wrap a reader (zero-arg callable yielding samples) with replay-
+    and-skip retries. Itself a reader: pass `RetryReader(r)` anywhere a
+    reader goes (Trainer.train, reader combinators)."""
+
+    def __init__(
+        self,
+        reader: Callable,
+        max_retries: int = 3,
+        base_delay_s: float = 0.05,
+        max_delay_s: float = 2.0,
+        jitter: float = 0.5,
+        seed: int = 0,
+        retry_on: Tuple[Type[BaseException], ...] = (Exception,),
+        stat_set: Optional[profiler.StatSet] = None,
+    ):
+        if max_retries < 0:
+            raise ValueError("max_retries must be >= 0")
+        self.reader = reader
+        self.max_retries = max_retries
+        self.base_delay_s = base_delay_s
+        self.max_delay_s = max_delay_s
+        self.jitter = jitter
+        self.seed = seed
+        self.retry_on = retry_on
+        self.stat_set = stat_set or profiler.global_stat_set()
+        self.retries = 0  # lifetime accounting across passes
+
+    def backoff(self, attempt: int, rng: random.Random) -> float:
+        """attempt is 1-based; exponential with multiplicative jitter."""
+        base = min(self.base_delay_s * (2 ** (attempt - 1)),
+                   self.max_delay_s)
+        return base * (1.0 + self.jitter * rng.random())
+
+    def __call__(self):
+        delivered = 0
+        attempts = 0
+        rng = random.Random(self.seed)
+        while True:
+            skip = delivered
+            try:
+                for sample in self.reader():
+                    # the injection point rides INSIDE the try: an armed
+                    # reader.next fault exercises exactly this machinery
+                    faults.fire("reader.next")
+                    if skip:
+                        skip -= 1
+                        continue
+                    delivered += 1
+                    yield sample
+                return
+            except self.retry_on as e:
+                attempts += 1
+                self.retries += 1
+                if attempts > self.max_retries:
+                    raise RetryExhausted(
+                        f"reader failed {attempts} times (budget "
+                        f"{self.max_retries} retries/pass, {delivered} "
+                        f"samples delivered): {e}") from e
+                delay = self.backoff(attempts, rng)
+                # count = retries, total = backoff seconds slept
+                self.stat_set.get("resilience/reader_retry").add(delay)
+                time.sleep(delay)

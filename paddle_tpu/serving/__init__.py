@@ -20,6 +20,7 @@ bounded). Layers, bottom-up:
 CLI: `python -m paddle_tpu serve --model_dir <saved_inference_model>`.
 """
 
+from ..resilience.breaker import CircuitBreaker, CircuitOpenError  # noqa: F401
 from .engine import BucketPolicy, ServingEngine  # noqa: F401
 from .batcher import DeadlineError, MicroBatcher, ShedError  # noqa: F401
 from .metrics import Histogram, MetricSet  # noqa: F401
@@ -31,6 +32,8 @@ __all__ = [
     "MicroBatcher",
     "ShedError",
     "DeadlineError",
+    "CircuitBreaker",
+    "CircuitOpenError",
     "MetricSet",
     "Histogram",
     "ModelRegistry",

@@ -21,11 +21,17 @@ Design (queue + window, the standard dynamic-batching contract):
   batches, never to wrong answers.
 - Each request carries a deadline (`timeout_ms` from submit time).
   Requests found expired at dispatch time fail with `DeadlineError`
-  (HTTP 504) without touching the device; a request that expires
-  mid-run still gets its (late) result, matching the usual "deadline
-  checked at dequeue" serving semantics.
+  (HTTP 504) without touching the device, and the deadline is
+  RE-CHECKED after the engine call, before results scatter: a request
+  that waited out its deadline inside a first-touch bucket compile
+  gets a clean DeadlineError/504, never a late 200 the client already
+  gave up on.
 - Results scatter back by row offsets; an engine exception fans out to
   every request in the batch.
+- An optional per-model CircuitBreaker (resilience.breaker) sits in
+  front of the queue: consecutive engine failures trip it open and
+  submissions fail fast with `CircuitOpenError` (HTTP 503) until a
+  half-open probe succeeds.
 """
 
 from __future__ import annotations
@@ -39,10 +45,12 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
+from ..resilience.breaker import CircuitBreaker, CircuitOpenError
 from .engine import ServingEngine
 from .metrics import MetricSet
 
-__all__ = ["MicroBatcher", "ShedError", "DeadlineError"]
+__all__ = ["MicroBatcher", "ShedError", "DeadlineError",
+           "CircuitOpenError"]
 
 
 class ShedError(RuntimeError):
@@ -82,8 +90,10 @@ class MicroBatcher:
         max_queue: int = 256,
         timeout_ms: float = 2000.0,
         metrics: Optional[MetricSet] = None,
+        breaker: Optional[CircuitBreaker] = None,
     ):
         self.engine = engine
+        self.breaker = breaker
         self.max_batch_size = (max_batch_size
                                or engine.policy.max_batch_size)
         self.max_wait_s = max_wait_ms / 1e3
@@ -141,6 +151,14 @@ class MicroBatcher:
             raise ValueError(
                 f"request rows {req.rows} exceed max_batch_size "
                 f"{self.max_batch_size}")
+        if self.breaker is not None and not self.breaker.admit():
+            self.metrics.counter_inc(
+                "circuit_open_total",
+                help="requests rejected because the model's circuit "
+                     "breaker was open")
+            raise CircuitOpenError(
+                f"circuit open for model {self.engine.model_name!r}; "
+                "retry later")
         with self._cond:
             if self._stopping:
                 raise ShedError("batcher stopped")
@@ -256,17 +274,34 @@ class MicroBatcher:
                 "requests_total", by=len(batch),
                 help="requests dispatched to the engine")
             outs = self.engine.predict(feed)
-            off = 0
-            for r in batch:
-                sliced = [
-                    o[off:off + r.rows]
-                    if (hasattr(o, "ndim") and o.ndim >= 1
-                        and o.shape[0] == total) else o
-                    for o in outs
-                ]
-                off += r.rows
-                r.future.set_result(sliced)
         except Exception as e:  # fan the failure out, keep serving
+            if self.breaker is not None:
+                self.breaker.record_failure()
             for r in batch:
                 if not r.future.done():
                     r.future.set_exception(e)
+            return
+        if self.breaker is not None:
+            self.breaker.record_success()
+        # deadline re-check AFTER the engine call: a first-touch bucket
+        # compile can outlast a request's deadline — the client that
+        # already gave up must see a clean 504, not a late 200
+        now = time.monotonic()
+        off = 0
+        for r in batch:
+            sliced = [
+                o[off:off + r.rows]
+                if (hasattr(o, "ndim") and o.ndim >= 1
+                    and o.shape[0] == total) else o
+                for o in outs
+            ]
+            off += r.rows
+            if r.deadline <= now:
+                self.metrics.counter_inc(
+                    "deadline_exceeded_total",
+                    help="requests that expired before dispatch")
+                r.future.set_exception(DeadlineError(
+                    "deadline exceeded during the engine run (cold "
+                    "bucket compile? warm the engine)"))
+            else:
+                r.future.set_result(sliced)

@@ -48,6 +48,7 @@ from ..core.executor import Executor, Scope
 from ..core.lod import LoDArray
 from ..io import load_inference_model
 from .. import profiler
+from ..resilience import faults
 from .metrics import MetricSet
 
 __all__ = ["BucketPolicy", "ServingEngine"]
@@ -259,6 +260,10 @@ class ServingEngine:
         t0 = time.perf_counter()
         with self._lock, profiler.timer(
                 f"serving/{self.model_name}/predict", always=True):
+            # chaos hook: an armed serving.predict fault is an engine
+            # failure — it must fan out to the batch, feed the circuit
+            # breaker, and surface as HTTP 500, never wedge the worker
+            faults.fire("serving.predict", model=self.model_name)
             if bucketed:
                 padded, n, seq_lens = self._pad_feed(feed)
                 nb = next(iter(padded.values())).shape[0]

@@ -20,8 +20,10 @@ Endpoints:
                            hit/miss counters, shed/deadline counters)
 
 Status mapping: 400 malformed request, 404 unknown model/route,
-503 load shed (queue full; includes Retry-After), 504 deadline
-exceeded, 500 engine failure.
+503 load shed (queue full) or circuit breaker open (both include
+Retry-After), 504 deadline exceeded, 500 engine failure. /healthz
+reports "degraded" plus per-model circuit state whenever any model's
+breaker is not closed.
 """
 
 from __future__ import annotations
@@ -34,9 +36,10 @@ from typing import Dict, Optional, Tuple
 import numpy as np
 
 from .. import profiler
+from ..resilience.breaker import STATE_CODES, CircuitBreaker, CircuitOpenError
 from .batcher import DeadlineError, MicroBatcher, ShedError
 from .engine import BucketPolicy, ServingEngine
-from .metrics import MetricSet
+from .metrics import MetricSet, _sanitize
 
 __all__ = ["ModelRegistry", "ServingServer", "make_server"]
 
@@ -57,6 +60,7 @@ class ModelRegistry:
         engine: Optional[ServingEngine] = None,
         batcher: Optional[MicroBatcher] = None,
         policy: Optional[BucketPolicy] = None,
+        breaker: Optional[CircuitBreaker] = None,
         **batcher_kw,
     ) -> Tuple[ServingEngine, MicroBatcher]:
         if engine is None:
@@ -65,8 +69,16 @@ class ModelRegistry:
             engine = ServingEngine(model_dir, policy=policy,
                                    model_name=name, metrics=self.metrics)
         if batcher is None:
+            # every registry-built model gets a circuit breaker: a model
+            # whose engine keeps failing must 503 fast, not queue-then-500
             batcher = MicroBatcher(engine, metrics=self.metrics,
+                                   breaker=breaker or CircuitBreaker(),
                                    **batcher_kw)
+        if batcher.breaker is not None:
+            self.metrics.gauge(
+                f"circuit_state_{_sanitize(name)}",
+                lambda b=batcher.breaker: STATE_CODES[b.state()],
+                help="circuit breaker state (0=closed 1=half_open 2=open)")
         self._models[name] = (engine, batcher)
         return engine, batcher
 
@@ -86,7 +98,21 @@ class ModelRegistry:
             b.stop()
 
     def stats(self) -> Dict[str, dict]:
-        return {n: e.stats() for n, (e, _) in self._models.items()}
+        out = {}
+        for n, (e, b) in self._models.items():
+            s = e.stats()
+            if b.breaker is not None:
+                s["circuit"] = b.breaker.stats()
+            out[n] = s
+        return out
+
+    def circuits(self) -> Dict[str, str]:
+        """Per-model circuit state (models without a breaker read
+        'closed' — they can't open)."""
+        return {
+            n: (b.breaker.state() if b.breaker is not None else "closed")
+            for n, (_, b) in self._models.items()
+        }
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -119,7 +145,13 @@ class _Handler(BaseHTTPRequestHandler):
     def do_GET(self):
         reg = self.server.registry
         if self.path == "/healthz":
-            self._send(200, {"status": "ok", "models": reg.names()})
+            circuits = reg.circuits()
+            degraded = [n for n, s in circuits.items() if s != "closed"]
+            self._send(200, {
+                "status": "degraded" if degraded else "ok",
+                "models": reg.names(),
+                "circuits": circuits,
+            })
         elif self.path == "/metrics":
             self._send(200, reg.metrics.render().encode(),
                        content_type="text/plain; version=0.0.4")
@@ -153,7 +185,7 @@ class _Handler(BaseHTTPRequestHandler):
         try:
             outs = batcher.predict(
                 feed, timeout_ms=req.get("timeout_ms"))
-        except ShedError as e:
+        except (ShedError, CircuitOpenError) as e:
             self._error(503, str(e))
             return
         except DeadlineError as e:

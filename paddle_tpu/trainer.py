@@ -19,6 +19,8 @@ checkpointing design parity, §5.3/§5.4 of SURVEY.md).
 from __future__ import annotations
 
 import logging
+import signal
+import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
@@ -37,6 +39,8 @@ from .core.program import (
     grad_var_name,
 )
 from .data.feeder import DataFeeder
+from .resilience import NonFiniteError, PreemptedError, faults
+from .resilience.guard import StepGuard
 
 __all__ = [
     "BeginPass",
@@ -116,6 +120,7 @@ class Trainer:
         scope: Optional[Scope] = None,
         checkpoint_config: Optional[CheckpointConfig] = None,
         executor: Optional[Executor] = None,
+        step_guard: Optional[StepGuard] = None,
     ):
         self.cost = cost
         self.main_program = main_program or default_main_program()
@@ -124,7 +129,13 @@ class Trainer:
         self.exe = executor or Executor(place)
         self.test_program = self.main_program.clone(for_test=True)
         self.checkpoint_config = checkpoint_config
+        # non-finite containment (resilience.StepGuard): explicit, or
+        # the default policy when FLAGS.step_guard is on
+        if step_guard is None and FLAGS.step_guard:
+            step_guard = StepGuard()
+        self.step_guard = step_guard
         self._stop = False
+        self._preempt_signal: Optional[int] = None
         self.step = 0  # global batch counter across passes
         self.start_pass = 0
         self._resume_batch = 0  # first batch to run in the resumed pass
@@ -172,10 +183,47 @@ class Trainer:
 
         prefetch_to_device > 0 enables the async double-buffered
         host→device pipeline (DataProvider.h:375 parity) with that queue
-        depth — batch N+1's transfer overlaps batch N's compute."""
+        depth — batch N+1's transfer overlaps batch N's compute.
+
+        Preemption: while training runs (main thread only), SIGTERM and
+        SIGINT are translated into finish-the-current-batch → emergency
+        mid-pass checkpoint (when checkpoint_config is set) →
+        PreemptedError; the CLI maps that to exit code 75 (EX_TEMPFAIL)
+        so schedulers reschedule instead of paging. Resume rides the
+        normal checkpoint machinery (`init()`)."""
         if not self._initialized:
             self.init()
         self._stop = False
+        self._preempt_signal = None
+        installed: Dict[int, Any] = {}
+        if threading.current_thread() is threading.main_thread():
+            def _on_preempt(signum, frame):
+                self._preempt_signal = signum
+                self._stop = True
+
+            for s in (signal.SIGTERM, signal.SIGINT):
+                try:
+                    installed[s] = signal.signal(s, _on_preempt)
+                except (ValueError, OSError):  # exotic embeddings
+                    pass
+        try:
+            return self._train(reader, num_passes, feed_order,
+                               event_handler, fetch_metrics, test_reader,
+                               prefetch_to_device)
+        finally:
+            for s, h in installed.items():
+                signal.signal(s, h)
+
+    def _train(
+        self,
+        reader: Callable,
+        num_passes: int,
+        feed_order: Optional[Sequence[Variable]] = None,
+        event_handler: Optional[Callable] = None,
+        fetch_metrics: Optional[Dict[str, Variable]] = None,
+        test_reader: Optional[Callable] = None,
+        prefetch_to_device: int = 0,
+    ) -> Dict[str, float]:
         handler = event_handler or (lambda e: None)
         feeder = DataFeeder(feed_order) if feed_order is not None else None
         metric_items = sorted((fetch_metrics or {}).items())
@@ -230,6 +278,7 @@ class Trainer:
                         if p.name in trained
                     ]
                     step_fetch += [grad_var_name(p) for p in stat_params]
+                faults.fire("executor.step", step=self.step)
                 with profiler.timer("forwardBackward"):
                     outs = self.exe.run(
                         self.main_program,
@@ -240,6 +289,7 @@ class Trainer:
                     # the d2h read of the cost fences async dispatch, so the
                     # timer measures device work, not enqueue time
                     cost = float(np.asarray(outs[0]))
+                grads = None
                 if want_stats:
                     # reference: TrainerInternal.cpp:81-109 param stats dump
                     grads = dict(zip(stat_params, outs[len(fetch_list):]))
@@ -249,6 +299,20 @@ class Trainer:
                     ).items():
                         print(f"  param {pname}: " + ", ".join(
                             f"{k}={v:.4g}" for k, v in st.items()))
+                guard = self.step_guard
+                if guard is not None and not guard.observe(
+                        cost, grads, scope=self.scope):
+                    # non-finite step: it is consumed (step counter,
+                    # events) but contributes nothing to the pass stats
+                    # and NEVER triggers the checkpoint cadence —
+                    # poisoned params must not become the "last good
+                    # checkpoint" a rollback would then restore
+                    self.step += 1
+                    handler(EndIteration(
+                        pass_id, batch_id, self.step, cost, {}))
+                    if guard.wants_rollback():
+                        self._rollback(guard)
+                    continue
                 batch_metrics = {
                     k: float(np.asarray(v))
                     for (k, _), v in zip(metric_items, outs[1:])
@@ -268,7 +332,10 @@ class Trainer:
             last_metrics = {"cost": float(np.mean(costs)) if costs else float("nan")}
             for i, (k, _) in enumerate(metric_items):
                 last_metrics[k] = float(metric_sums[i] / n)
-            if test_reader is not None:
+            if test_reader is not None and self._preempt_signal is None:
+                # a preempted run skips the evaluation pass: the grace
+                # window between SIGTERM and SIGKILL is for the
+                # emergency checkpoint, not for metrics
                 test_metrics = self.test(test_reader, feed_order, fetch_metrics)
                 last_metrics.update({f"test_{k}": v for k, v in test_metrics.items()})
             handler(EndPass(pass_id, last_metrics))
@@ -288,6 +355,13 @@ class Trainer:
                 break
             if cc and cc.epoch_interval and (pass_id + 1) % cc.epoch_interval == 0:
                 self._save_checkpoint(pass_id)
+        if self._preempt_signal is not None:
+            try:
+                signame = signal.Signals(self._preempt_signal).name
+            except ValueError:
+                signame = f"signal {self._preempt_signal}"
+            raise PreemptedError(
+                signame, checkpointed=self.checkpoint_config is not None)
         return last_metrics
 
     # -- testing (paddle/trainer/Tester.cpp; v2 trainer.test) --------------
@@ -314,6 +388,27 @@ class Trainer:
         for i, (k, _) in enumerate(metric_items):
             out[k] = float(sums[i + 1] / n)
         return out
+
+    # -- non-finite recovery (resilience.StepGuard) -------------------------
+    def _rollback(self, guard: StepGuard) -> None:
+        """K consecutive non-finite steps: restore the newest VALID
+        checkpoint (load_checkpoint quarantines corrupt serials itself)
+        and enter the guard's reduced-LR cool-down. Training continues
+        from the current reader position — the poisoned batch window is
+        effectively skipped, which is the production trade the guard
+        documents."""
+        cc = self.checkpoint_config
+        serial = (io.get_latest_checkpoint_serial(cc.checkpoint_dir)
+                  if cc else -1)
+        if serial < 0:
+            raise NonFiniteError(
+                f"{guard.bad_streak} consecutive non-finite steps and no "
+                "checkpoint to roll back to (set checkpoint_config to "
+                "make the StepGuard recoverable)")
+        args = io.load_checkpoint(
+            cc.checkpoint_dir, self.main_program, self.scope)
+        self.step = int(args.get("step", self.step))
+        guard.after_rollback(self.main_program, self.scope)
 
     # -- checkpointing ------------------------------------------------------
     def _save_checkpoint(self, pass_id: int, batch_id: Optional[int] = None) -> None:

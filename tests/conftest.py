@@ -29,7 +29,32 @@ jax.config.update("jax_platforms", "cpu")
 # faithful CPU reference path for tests)
 jax.config.update("jax_default_matmul_precision", "highest")
 
+import faulthandler  # noqa: E402
+
 import pytest  # noqa: E402
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: long-running; excluded from the tier-1 run (-m 'not slow')")
+    config.addinivalue_line(
+        "markers",
+        "chaos: fault-injection / crash-recovery tests "
+        "(paddle_tpu.resilience); the fast deterministic subset runs in "
+        "tier-1, subprocess e2e cases are additionally marked slow")
+    # hung multi-process / subprocess tests must leave a diagnosis: dump
+    # every thread's traceback shortly before the tier-1 `timeout -k`
+    # wrapper would SIGKILL the run (and again every interval for longer
+    # local runs). PT_TEST_FAULTHANDLER_TIMEOUT=0 disables.
+    faulthandler.enable()
+    dump_after = float(os.environ.get("PT_TEST_FAULTHANDLER_TIMEOUT", "840"))
+    if dump_after > 0:
+        faulthandler.dump_traceback_later(dump_after, repeat=True)
+
+
+def pytest_unconfigure(config):
+    faulthandler.cancel_dump_traceback_later()
 
 
 @pytest.fixture(autouse=True)

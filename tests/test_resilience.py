@@ -1,0 +1,672 @@
+"""paddle_tpu.resilience: fault injection, checkpoint hardening,
+StepGuard, preemption, RetryReader, circuit breaker.
+
+The contract under test (ISSUE 4 acceptance): every fault point fires
+deterministically under seeded injection and is a zero-overhead no-op
+when disarmed; a torn/corrupt checkpoint — even one whose meta marker
+is present — costs one checkpoint interval (quarantine + fall back to
+the newest valid serial), never the run. The subprocess chaos e2e
+(SIGKILL + corruption + resume → bit-identical params) lives in
+test_chaos.py.
+"""
+
+import os
+import signal
+import socket
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import io as pio
+from paddle_tpu.resilience import (
+    CircuitBreaker,
+    CircuitOpenError,
+    InjectedFault,
+    NonFiniteError,
+    PreemptedError,
+    RetryExhausted,
+    RetryReader,
+    StepGuard,
+    faults,
+)
+from paddle_tpu.resilience.breaker import CLOSED, HALF_OPEN, OPEN
+
+
+# ------------------------------------------------------------- fault registry
+
+
+@pytest.mark.chaos
+def test_fault_hit_fires_deterministically():
+    faults.arm("executor.step", hit=3)
+    assert faults.fire("executor.step") is None
+    assert faults.fire("executor.step") is None
+    with pytest.raises(InjectedFault, match="executor.step.*hit 3"):
+        faults.fire("executor.step")
+    # one-shot: later hits pass again
+    assert faults.fire("executor.step") is None
+    st = faults.stats()["executor.step"]
+    assert st["hits"] == 4 and st["fired"] == 1 and st["armed"]
+
+
+@pytest.mark.chaos
+def test_fault_seeded_probability_is_reproducible():
+    def pattern():
+        faults.reset()
+        faults.arm("reader.next", p=0.5, seed=11)
+        out = []
+        for _ in range(20):
+            try:
+                faults.fire("reader.next")
+                out.append(0)
+            except InjectedFault:
+                out.append(1)
+        return out
+
+    a, b = pattern(), pattern()
+    assert a == b and sum(a) > 0, a
+    faults.reset()
+
+
+@pytest.mark.chaos
+def test_fault_times_caps_probability_fires():
+    faults.arm("reader.next", p=1.0, times=2)
+    fired = 0
+    for _ in range(5):
+        try:
+            faults.fire("reader.next")
+        except InjectedFault:
+            fired += 1
+    assert fired == 2
+
+
+def test_fault_disarmed_is_noop():
+    assert not faults.is_armed()
+    assert faults.fire("executor.step") is None
+    # no accounting either: the disarmed fast path touches nothing
+    assert faults.stats() == {}
+
+
+@pytest.mark.chaos
+def test_fault_spec_string_round_trip():
+    faults.arm_from_spec(
+        "ckpt.write:hit=2:action=corrupt; serving.predict:p=0.25:seed=3")
+    assert faults.is_armed("ckpt.write")
+    assert faults.is_armed("serving.predict")
+    assert faults.fire("ckpt.write") is None
+    assert faults.fire("ckpt.write") == "corrupt"
+
+
+def test_fault_bad_specs_rejected():
+    with pytest.raises(ValueError, match="unknown fault point"):
+        faults.arm("ckpt.wrote", hit=1)
+    with pytest.raises(ValueError, match="exactly one"):
+        faults.arm("ckpt.write")
+    with pytest.raises(ValueError, match="exactly one"):
+        faults.arm("ckpt.write", hit=1, p=0.5)
+    with pytest.raises(ValueError, match="action"):
+        faults.arm("ckpt.write", hit=1, action="explode")
+    with pytest.raises(ValueError, match="1-based"):
+        faults.arm("ckpt.write", hit=0)
+
+
+# -------------------------------------------------------- checkpoint harden
+
+
+def _build_regression():
+    x = pt.layers.data("x", shape=[4])
+    y = pt.layers.data("y", shape=[1])
+    pred = pt.layers.fc(x, size=1)
+    loss = pt.layers.mean(pt.layers.square_error_cost(pred, y))
+    return loss
+
+
+def _feed(seed=0, n=8, nan=False):
+    rng = np.random.RandomState(seed)
+    xs = rng.randn(n, 4).astype(np.float32)
+    if nan:
+        xs[0, 0] = np.nan
+    return {"x": xs, "y": xs.sum(1, keepdims=True).astype(np.float32)}
+
+
+def _two_checkpoints(d):
+    """Train a step, checkpoint, train, checkpoint → serials 0 and 1."""
+    loss = _build_regression()
+    pt.optimizer.SGD(learning_rate=0.05).minimize(loss)
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    exe.run(feed=_feed(0), fetch_list=[loss])
+    pio.save_checkpoint(d, {"step": 1})
+    exe.run(feed=_feed(1), fetch_list=[loss])
+    pio.save_checkpoint(d, {"step": 2})
+    return loss
+
+
+@pytest.mark.chaos
+def test_truncated_newest_checkpoint_falls_back_and_quarantines(tmp_path):
+    d = str(tmp_path / "ck")
+    _two_checkpoints(d)
+    # torn write with the meta marker present — the ISSUE io.py:354 case
+    p = os.path.join(d, "checkpoint_1", pio.PARAMS_FILE)
+    with open(p, "r+b") as f:
+        f.truncate(os.path.getsize(p) // 2)
+    # hash now mismatches → quarantine + fall back to serial 0
+    with pytest.warns(UserWarning, match="quarantined"):
+        args = pio.load_checkpoint(d)
+    assert args["step"] == 1
+    assert os.path.isdir(os.path.join(d, "checkpoint_1.corrupt"))
+    assert pio.get_latest_checkpoint_serial(d) == 0
+
+
+@pytest.mark.chaos
+def test_bitflip_detected_by_integrity_hash(tmp_path):
+    d = str(tmp_path / "ck")
+    _two_checkpoints(d)
+    p = os.path.join(d, "checkpoint_1", pio.PARAMS_FILE)
+    blob = bytearray(open(p, "rb").read())
+    blob[len(blob) // 2] ^= 0xFF  # same length, one byte of rot
+    open(p, "wb").write(bytes(blob))
+    with pytest.raises(pio.CheckpointCorruptError, match="sha256"):
+        pio.verify_checkpoint(os.path.join(d, "checkpoint_1"))
+    with pytest.warns(UserWarning, match="quarantined"):
+        assert pio.load_checkpoint(d)["step"] == 1
+
+
+@pytest.mark.chaos
+def test_get_latest_serial_verify_skips_corrupt(tmp_path):
+    d = str(tmp_path / "ck")
+    _two_checkpoints(d)
+    p = os.path.join(d, "checkpoint_1", pio.PARAMS_FILE)
+    with open(p, "r+b") as f:
+        f.truncate(3)
+    assert pio.get_latest_checkpoint_serial(d) == 1  # unverified view
+    assert pio.get_latest_checkpoint_serial(d, verify=True) == 0
+    # read-only: nothing was quarantined by the verify pass
+    assert os.path.isdir(os.path.join(d, "checkpoint_1"))
+
+
+@pytest.mark.chaos
+def test_all_serials_corrupt_raises(tmp_path):
+    d = str(tmp_path / "ck")
+    _two_checkpoints(d)
+    for s in (0, 1):
+        p = os.path.join(d, f"checkpoint_{s}", pio.PARAMS_FILE)
+        with open(p, "r+b") as f:
+            f.truncate(1)
+    with pytest.warns(UserWarning, match="quarantined"):
+        with pytest.raises(FileNotFoundError, match="2 corrupt"):
+            pio.load_checkpoint(d)
+
+
+@pytest.mark.chaos
+def test_injected_torn_write_with_meta_survives(tmp_path):
+    """ckpt.write corrupt action: the save PUBLISHES a torn npz and the
+    meta marker still lands — load must fall back, not crash."""
+    d = str(tmp_path / "ck")
+    loss = _build_regression()
+    pt.optimizer.SGD(learning_rate=0.05).minimize(loss)
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    exe.run(feed=_feed(0), fetch_list=[loss])
+    pio.save_checkpoint(d, {"step": 1})
+    faults.arm("ckpt.write", hit=1, action="corrupt")
+    pio.save_checkpoint(d, {"step": 2})
+    faults.disarm()
+    assert faults.stats()["ckpt.write"]["fired"] == 1
+    assert os.path.exists(os.path.join(d, "checkpoint_1", pio.META_FILE))
+    with pytest.warns(UserWarning, match="quarantined"):
+        assert pio.load_checkpoint(d)["step"] == 1
+
+
+@pytest.mark.chaos
+def test_injected_meta_fault_leaves_checkpoint_invisible(tmp_path):
+    """Dying between payload and meta (ckpt.meta raise) must leave the
+    serial incomplete — invisible to the scan, previous one loads."""
+    d = str(tmp_path / "ck")
+    loss = _build_regression()
+    pt.optimizer.SGD(learning_rate=0.05).minimize(loss)
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    pio.save_checkpoint(d, {"step": 1})
+    faults.arm("ckpt.meta", hit=1)
+    with pytest.raises(InjectedFault):
+        pio.save_checkpoint(d, {"step": 2})
+    faults.disarm()
+    assert pio.get_latest_checkpoint_serial(d) == 0
+    assert pio.load_checkpoint(d)["step"] == 1
+
+
+@pytest.mark.chaos
+def test_injected_write_failure_keeps_previous_checkpoint(tmp_path):
+    d = str(tmp_path / "ck")
+    loss = _build_regression()
+    pt.optimizer.SGD(learning_rate=0.05).minimize(loss)
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    pio.save_checkpoint(d, {"step": 1})
+    faults.arm("ckpt.write", hit=1)
+    with pytest.raises(InjectedFault):
+        pio.save_checkpoint(d, {"step": 2})
+    faults.disarm()
+    assert pio.load_checkpoint(d)["step"] == 1
+
+
+def test_retention_spares_incomplete_serials(tmp_path):
+    """An incomplete dir (no meta — possibly mid-write by another
+    process) must never be swept; complete old serials are."""
+    d = str(tmp_path / "ck")
+    loss = _build_regression()
+    pt.optimizer.SGD(learning_rate=0.05).minimize(loss)
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    pio.save_checkpoint(d, {"step": 1}, max_num_checkpoints=2)
+    # manufacture an in-flight (incomplete) serial 50: payload, no meta
+    os.makedirs(os.path.join(d, "checkpoint_50"))
+    open(os.path.join(d, "checkpoint_50", pio.PARAMS_FILE), "wb").close()
+    for step in (2, 3, 4):
+        pio.save_checkpoint(d, {"step": step}, max_num_checkpoints=2)
+    kept = sorted(n for n in os.listdir(d) if n.startswith("checkpoint_"))
+    # the new saves took serials 1..3 (allocation counts complete
+    # serials only); retention kept the newest 2 complete ones and never
+    # touched the incomplete 50
+    assert kept == ["checkpoint_2", "checkpoint_3", "checkpoint_50"], kept
+
+
+# --------------------------------------------------------------- StepGuard
+
+
+def _nan_reader(nan_batches, total=10, n=8):
+    def reader():
+        for i in range(total):
+            yield _feed(seed=i, n=n, nan=i in nan_batches)
+    return reader
+
+
+@pytest.mark.chaos
+def test_step_guard_skips_and_rolls_back(tmp_path):
+    d = str(tmp_path / "ck")
+    loss = _build_regression()
+    pt.optimizer.SGD(learning_rate=0.05).minimize(loss)
+    guard = StepGuard(max_consecutive=2, cooldown_steps=2, lr_factor=0.1)
+    cc = pt.CheckpointConfig(d, epoch_interval=0, step_interval=2)
+    t = pt.Trainer(loss, checkpoint_config=cc, step_guard=guard)
+    # batches 4..6 are poisoned. The updates were already applied when
+    # the guard sees the loss, so each NaN batch re-poisons the params:
+    # rollback #1 fires after batches 4+5; batch 6 poisons again and the
+    # (clean-input) batch 7 still reads NaN off the params → rollback
+    # #2; batches 8-9 then run clean and end the cool-down.
+    m = t.train(_nan_reader({4, 5, 6}), num_passes=1)
+    assert np.isfinite(m["cost"]), m
+    st = guard.stats()
+    assert st["skipped"] == 4 and st["rollbacks"] == 2, st
+    # parameters are finite after recovery
+    w = np.asarray(pt.global_scope().get(
+        pt.default_main_program().parameters()[0].name))
+    assert np.isfinite(w).all()
+    # cool-down ended (≥2 clean steps ran after the rollback): LR is back
+    # to its base value
+    lr_names = [v.name for v in pt.default_main_program().persistables()
+                if v.name.endswith(".lr")]
+    assert lr_names
+    lr = float(np.asarray(pt.global_scope().get(lr_names[0])))
+    assert lr == pytest.approx(0.05)
+
+
+@pytest.mark.chaos
+def test_step_guard_poisoned_checkpoint_cadence_suppressed(tmp_path):
+    """A checkpoint must never be written off the back of a skipped
+    (non-finite) step — the cadence counter lands on a bad step and the
+    save is suppressed."""
+    d = str(tmp_path / "ck")
+    loss = _build_regression()
+    pt.optimizer.SGD(learning_rate=0.05).minimize(loss)
+    guard = StepGuard(max_consecutive=1, cooldown_steps=1)
+    cc = pt.CheckpointConfig(d, epoch_interval=0, step_interval=1,
+                             max_num_checkpoints=100)
+    t = pt.Trainer(loss, checkpoint_config=cc, step_guard=guard)
+    # cadence is EVERY step; batch 2 is poisoned — the skipped step must
+    # not produce a serial, and every serial that exists holds finite
+    # params (the rollback restored before the next save)
+    t.train(_nan_reader({2}, total=6), num_passes=1)
+    assert guard.stats()["rollbacks"] == 1
+    latest = pio.get_latest_checkpoint_serial(d)
+    assert latest >= 2
+    for s in range(latest + 1):
+        sd = os.path.join(d, f"checkpoint_{s}")
+        if not os.path.isdir(sd):
+            continue  # swept or never written (the skipped step)
+        pt.reset_global_scope()
+        pio.load_vars(sd)
+        for name in pt.global_scope().keys():
+            assert np.isfinite(np.asarray(pt.global_scope().get(name))).all()
+
+
+@pytest.mark.chaos
+def test_step_guard_without_checkpoint_raises():
+    loss = _build_regression()
+    pt.optimizer.SGD(learning_rate=0.05).minimize(loss)
+    guard = StepGuard(max_consecutive=2)
+    t = pt.Trainer(loss, step_guard=guard)
+    with pytest.raises(NonFiniteError, match="no checkpoint"):
+        t.train(_nan_reader(set(range(10))), num_passes=1)
+
+
+# -------------------------------------------------------------- preemption
+
+
+@pytest.mark.chaos
+def test_sigterm_preempts_with_emergency_checkpoint(tmp_path):
+    d = str(tmp_path / "ck")
+    loss = _build_regression()
+    pt.optimizer.SGD(learning_rate=0.05).minimize(loss)
+    cc = pt.CheckpointConfig(d, epoch_interval=0)  # NO cadence at all
+    t = pt.Trainer(loss, checkpoint_config=cc)
+
+    def preempt_at_3(e):
+        if isinstance(e, pt.EndIteration) and e.step == 3:
+            os.kill(os.getpid(), signal.SIGTERM)
+
+    with pytest.raises(PreemptedError, match="SIGTERM"):
+        t.train(_nan_reader(set()), num_passes=3,
+                event_handler=preempt_at_3)
+    # the emergency checkpoint recorded the mid-pass position
+    args = pio.load_checkpoint(d)
+    assert args["step"] == 3 and args["mid_pass"] and args["batch_id"] == 2
+    # resume re-enters pass 0 at batch 3
+    pt.reset_global_scope()
+    t2 = pt.Trainer(loss, checkpoint_config=cc)
+    t2.init()
+    assert t2.start_pass == 0 and t2._resume_batch == 3 and t2.step == 3
+    # and the original SIGTERM disposition was restored on the way out
+    assert signal.getsignal(signal.SIGTERM) == signal.SIG_DFL
+
+
+def test_preempt_exit_code_is_ex_tempfail():
+    from paddle_tpu.resilience import PREEMPT_EXIT_CODE
+
+    assert PREEMPT_EXIT_CODE == 75  # BSD sysexits EX_TEMPFAIL
+
+
+_PREEMPT_CFG = '''
+import os
+import signal
+
+import numpy as np
+
+import paddle_tpu as pt
+
+
+def get_model():
+    x = pt.layers.data("x", shape=[4])
+    y = pt.layers.data("y", shape=[1])
+    pred = pt.layers.fc(x, size=1)
+    loss = pt.layers.mean(pt.layers.square_error_cost(pred, y))
+    pt.optimizer.SGD(learning_rate=0.05).minimize(loss)
+
+    def reader():
+        rng = np.random.RandomState(0)
+        for i in range(10):
+            if i == 3:  # the scheduler preempts us mid-pass
+                os.kill(os.getpid(), signal.SIGTERM)
+            xs = rng.randn(4, 4).astype(np.float32)
+            yield {"x": xs, "y": xs.sum(1, keepdims=True)}
+
+    return {"cost": loss, "reader": reader, "num_passes": 3}
+'''
+
+
+@pytest.mark.chaos
+def test_cli_train_maps_preemption_to_exit_75(tmp_path, capsys):
+    from paddle_tpu import cli
+    from paddle_tpu.resilience import PREEMPT_EXIT_CODE
+
+    cfg = tmp_path / "cfg.py"
+    cfg.write_text(_PREEMPT_CFG)
+    rc = cli.main(["train", "--config", str(cfg),
+                   "--save_dir", str(tmp_path / "ck")])
+    assert rc == PREEMPT_EXIT_CODE
+    assert "preempted" in capsys.readouterr().out
+    # the emergency checkpoint is there for the rescheduled run
+    assert pio.get_latest_checkpoint_serial(str(tmp_path / "ck")) >= 0
+
+
+# ------------------------------------------------------------- RetryReader
+
+
+@pytest.mark.chaos
+def test_retry_reader_replays_and_delivers_everything():
+    faults.arm("reader.next", hit=5)  # one failure mid-stream
+
+    def reader():
+        for i in range(8):
+            yield i
+
+    rr = RetryReader(reader, base_delay_s=0.001, max_delay_s=0.002)
+    assert list(rr()) == list(range(8))
+    assert rr.retries == 1
+    st = faults.stats()["reader.next"]
+    assert st["fired"] == 1
+
+
+@pytest.mark.chaos
+def test_retry_reader_budget_exhausts():
+    faults.arm("reader.next", p=1.0)  # every sample fails
+
+    def reader():
+        yield from range(4)
+
+    rr = RetryReader(reader, max_retries=2, base_delay_s=0.001)
+    with pytest.raises(RetryExhausted, match="budget 2"):
+        list(rr())
+    assert rr.retries == 3  # initial + 2 retries, all failed
+
+
+@pytest.mark.chaos
+def test_retry_reader_counts_into_stat_set():
+    # hit numbering advances across replays (replayed samples re-fire):
+    # hit 2 fails run 1, the replay covers hits 3-8, hit 7 fails it again
+    faults.arm("reader.next", hits=(2, 7))
+    stats = pt.profiler.StatSet()
+
+    def reader():
+        yield from range(6)
+
+    rr = RetryReader(reader, base_delay_s=0.001, stat_set=stats)
+    assert list(rr()) == list(range(6))
+    s = stats.get("resilience/reader_retry")
+    assert s.count == 2 and s.total > 0
+
+
+def test_retry_reader_trains(tmp_path):
+    """A RetryReader drops in anywhere a reader goes."""
+    faults.arm("reader.next", hit=3)
+    loss = _build_regression()
+    pt.optimizer.SGD(learning_rate=0.05).minimize(loss)
+    t = pt.Trainer(loss)
+    m = t.train(RetryReader(_nan_reader(set(), total=6),
+                            base_delay_s=0.001),
+                num_passes=1)
+    assert np.isfinite(m["cost"]) and t.step == 6
+
+
+# ---------------------------------------------------------- circuit breaker
+
+
+def test_circuit_breaker_state_machine():
+    clock = [0.0]
+    b = CircuitBreaker(failure_threshold=3, reset_timeout_s=10.0,
+                       clock=lambda: clock[0])
+    assert b.state() == CLOSED and b.admit()
+    for _ in range(2):
+        b.record_failure()
+    assert b.state() == CLOSED  # threshold is 3 CONSECUTIVE
+    b.record_success()
+    for _ in range(3):
+        b.record_failure()
+    assert b.state() == OPEN and not b.admit()
+    clock[0] = 9.9
+    assert not b.admit()
+    clock[0] = 10.0
+    assert b.state() == HALF_OPEN
+    assert b.admit()          # the probe
+    assert not b.admit()      # probe budget spent
+    b.record_failure()        # probe failed → re-open, timer restarts
+    assert b.state() == OPEN and not b.admit()
+    clock[0] = 20.0
+    assert b.admit()
+    b.record_success()        # probe succeeded → closed
+    assert b.state() == CLOSED and b.admit()
+    assert b.stats()["opens"] == 2
+
+
+class _FakeEngine:
+    """Just enough surface for MicroBatcher."""
+
+    class policy:
+        max_batch_size = 8
+
+    def __init__(self, metrics=None, fail=False, delay_s=0.0):
+        from paddle_tpu.serving import MetricSet
+
+        self.metrics = metrics or MetricSet()
+        self.model_name = "fake"
+        self.fail = fail
+        self.delay_s = delay_s
+        self.calls = 0
+
+    def predict(self, feed):
+        self.calls += 1
+        if self.delay_s:
+            time.sleep(self.delay_s)
+        if self.fail:
+            raise RuntimeError("engine down")
+        return [feed["x"] * 2.0]
+
+
+@pytest.mark.chaos
+def test_batcher_breaker_opens_and_half_open_recovers():
+    from paddle_tpu.serving import MicroBatcher
+
+    clock = [0.0]
+    eng = _FakeEngine(fail=True)
+    b = MicroBatcher(eng, max_wait_ms=1.0,
+                     breaker=CircuitBreaker(failure_threshold=2,
+                                            reset_timeout_s=5.0,
+                                            clock=lambda: clock[0]))
+    b.start()
+    try:
+        feed = {"x": np.ones((1, 2), np.float32)}
+        for _ in range(2):
+            with pytest.raises(RuntimeError, match="engine down"):
+                b.predict(feed, timeout_ms=2000)
+        # circuit now open: submission fails fast, engine untouched
+        calls = eng.calls
+        with pytest.raises(CircuitOpenError, match="circuit open"):
+            b.predict(feed, timeout_ms=2000)
+        assert eng.calls == calls
+        assert b.metrics.counter_value("circuit_open_total") == 1
+        # heal the engine, step past the reset timeout → probe closes it
+        eng.fail = False
+        clock[0] = 5.0
+        (out,) = b.predict(feed, timeout_ms=2000)
+        np.testing.assert_array_equal(out, feed["x"] * 2.0)
+        assert b.breaker.state() == CLOSED
+    finally:
+        b.stop()
+
+
+@pytest.mark.chaos
+def test_deadline_rechecked_after_engine_run():
+    """A request that expires INSIDE the engine call (cold bucket
+    compile) gets a clean DeadlineError, not a late 200."""
+    from paddle_tpu.serving import MicroBatcher
+
+    eng = _FakeEngine(delay_s=0.25)
+    b = MicroBatcher(eng, max_wait_ms=1.0)
+    b.start()
+    try:
+        with pytest.raises(DeadlineError, match="during the engine run"):
+            b.predict({"x": np.ones((1, 2), np.float32)}, timeout_ms=60)
+        assert eng.calls == 1  # it DID run — the result was just too late
+        # an unexpired request straight after is served normally
+        (out,) = b.predict({"x": np.ones((1, 2), np.float32)},
+                           timeout_ms=5000)
+        assert out.shape == (1, 2)
+    finally:
+        b.stop()
+
+
+from paddle_tpu.serving import DeadlineError  # noqa: E402  (test helper)
+
+
+@pytest.mark.chaos
+def test_serving_predict_fault_point_feeds_breaker(tmp_path):
+    """An armed serving.predict fault is an engine failure end to end:
+    fans out to callers, trips the breaker, /healthz degrades."""
+    import json
+    import urllib.request
+
+    from paddle_tpu.serving import ModelRegistry, make_server
+
+    # build + save a tiny model
+    pt.reset()
+    x = pt.layers.data("x", shape=[4])
+    pred = pt.layers.fc(x, size=1)
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    d = str(tmp_path / "m")
+    pt.io.save_inference_model(d, ["x"], [pred])
+
+    reg = ModelRegistry()
+    reg.add("m", model_dir=d,
+            breaker=CircuitBreaker(failure_threshold=2, reset_timeout_s=60))
+    srv = make_server(reg)
+    srv.serve_background()
+    try:
+        url = f"http://127.0.0.1:{srv.port}"
+        faults.arm("serving.predict", p=1.0)
+        body = json.dumps({"inputs": {"x": [[0, 0, 0, 0]]}}).encode()
+        codes = []
+        for _ in range(3):
+            try:
+                urllib.request.urlopen(urllib.request.Request(
+                    url + "/predict/m", data=body,
+                    headers={"Content-Type": "application/json"}),
+                    timeout=30)
+                codes.append(200)
+            except urllib.error.HTTPError as e:
+                codes.append(e.code)
+        faults.disarm()
+        assert codes[:2] == [500, 500] and codes[2] == 503, codes
+        with urllib.request.urlopen(url + "/healthz", timeout=30) as r:
+            h = json.load(r)
+        assert h["status"] == "degraded" and h["circuits"]["m"] == "open"
+        with urllib.request.urlopen(url + "/metrics", timeout=30) as r:
+            m = r.read().decode()
+        assert "ptserving_circuit_state_m 2" in m
+        assert "ptserving_circuit_open_total" in m
+    finally:
+        srv.shutdown()
+        reg.stop()
+        srv.server_close()
+
+
+# ------------------------------------------------------- download timeout
+
+
+def test_download_counts_socket_timeouts(tmp_path, monkeypatch):
+    from paddle_tpu.data.datasets import common
+
+    monkeypatch.setenv("PADDLE_TPU_DATA_HOME", str(tmp_path))
+    calls = []
+
+    def stalled(url, timeout=None):
+        calls.append(timeout)
+        raise socket.timeout("recv stalled")
+
+    monkeypatch.setattr("urllib.request.urlopen", stalled)
+    with pytest.raises(RuntimeError, match=r"3 of them stalled past"):
+        common.download("http://mirror/x.tgz", "unit", "0" * 32,
+                        timeout=0.5)
+    assert calls == [0.5, 0.5, 0.5]  # timeout reached urlopen, 3 tries
