@@ -43,6 +43,15 @@ batch: a host-side numpy reader → DevicePrefetcher (async double-buffered
 h2d) → per-step exe.run, i.e. what Trainer.train drives. The ratio to the
 device-staged number is the pipeline efficiency (PERF.md).
 
+BENCH_MODEL=train_loop measures the Trainer's own step-loop overhead
+(CPU-safe, small MLP): steps/sec, host syncs/step and host-blocked
+fraction for the synchronous loop (sync_every=1, the pre-pipeline
+behavior) vs the async loop (on-device metric accumulation, pass-end
+sync). Asserts — via the Trainer's sync-counter hook, so it holds on
+CPU CI where wall clock is noise — that async fences strictly less
+often, and that both modes end with bit-identical parameters
+(PERF.md "Async dispatch and the host-sync budget").
+
 BENCH_RAGGED=1 (lstm/nmt) measures the no-padding claim: effective
 (real-token) throughput of length-bucketed LoD batching vs pad-to-max on
 a lognormal length distribution (run_ragged; PERF.md "ragged" section).
@@ -362,6 +371,9 @@ _ALL_MODELS = [
     ("nmt_ragged", {"BENCH_MODEL": "nmt", "BENCH_RAGGED": "1"}),
     ("transformer", {"BENCH_HIDDEN": "2048", "BENCH_DEPTH": "8",
                      "BENCH_BATCH": "8", "BENCH_REMAT": "full"}),
+    # host-sync budget of the Trainer loop itself (sync vs async
+    # dispatch) — CPU-safe, so it also populates on smoke runs
+    ("train_loop", {"BENCH_STEPS": "60", "BENCH_BATCH": "64"}),
 ]
 
 
@@ -816,6 +828,97 @@ def run_infer(model, batch, steps):
     print(json.dumps(out_rec))
 
 
+def run_train_loop(batch, steps):
+    """BENCH_MODEL=train_loop: the host-side cost of the Trainer step
+    loop itself, sync vs async dispatch (ISSUE 5 acceptance).
+
+    Same fixed-seed model, same data, two runs through Trainer.train:
+      sync  — log_interval=1: every step reads the cost back, fencing
+              XLA's dispatch queue (the pre-pipeline loop)
+      async — log_interval=steps: cost/metrics fold into the jitted
+              on-device accumulator; one readback at pass end
+    Reports steps/sec, host syncs per step (the Trainer's sync-counter
+    hook — deterministic, unlike wall clock on shared CPU CI) and the
+    host-blocked fraction (hostSync timer / wall). Asserts async fences
+    strictly less often than sync AND that final parameters are
+    bit-identical across modes — the pipelining must change when the
+    host waits, never what the device computes."""
+    import paddle_tpu as pt
+    from paddle_tpu import profiler
+    from paddle_tpu.flags import FLAGS
+
+    hidden = int(os.environ.get("BENCH_HIDDEN", 256))
+    rng = np.random.RandomState(0)
+    xs = rng.randn(steps * batch, 16).astype(np.float32)
+    ys = (xs @ rng.randn(16, 1)).astype(np.float32)
+
+    def reader():
+        for i in range(steps):
+            yield {"x": xs[i * batch:(i + 1) * batch],
+                   "y": ys[i * batch:(i + 1) * batch]}
+
+    saved_timers = FLAGS.enable_timers
+    FLAGS.enable_timers = True
+    results, params = {}, {}
+    try:
+        for mode, interval in (("sync", 1), ("async", steps)):
+            pt.reset()
+            prog, startup = pt.Program(), pt.Program()
+            startup.random_seed = 11
+            with pt.program_guard(prog, startup):
+                x = pt.layers.data("x", shape=[16])
+                y = pt.layers.data("y", shape=[1])
+                h = pt.layers.fc(x, size=hidden, act="tanh")
+                pred = pt.layers.fc(h, size=1)
+                loss = pt.layers.mean(pt.layers.square_error_cost(pred, y))
+                pt.optimizer.SGD(learning_rate=0.01).minimize(loss)
+            trainer = pt.Trainer(loss, main_program=prog,
+                                 startup_program=startup)
+            # pass 0 pays compile; pass 1 is the timed steady state
+            trainer.train(reader, num_passes=1, log_interval=interval)
+            stats = profiler.global_stat_set()
+            stats.reset()
+            syncs0 = trainer.host_sync_count
+            t0 = time.perf_counter()
+            trainer.train(reader, num_passes=1, log_interval=interval)
+            dt = time.perf_counter() - t0
+            blocked = stats.stats.get("hostSync")
+            results[mode] = {
+                "steps_per_sec": round(steps / dt, 1),
+                "host_syncs_per_step": round(
+                    (trainer.host_sync_count - syncs0) / steps, 3),
+                "host_blocked_fraction": round(
+                    (blocked.total if blocked else 0.0) / dt, 3),
+            }
+            params[mode] = {
+                p.name: np.asarray(pt.global_scope().get(p.name))
+                for p in prog.parameters()
+            }
+    finally:
+        FLAGS.enable_timers = saved_timers
+    # the acceptance assertions: deterministic on any backend
+    assert (results["async"]["host_syncs_per_step"]
+            < results["sync"]["host_syncs_per_step"]), results
+    identical = sorted(params["sync"]) == sorted(params["async"]) and all(
+        np.array_equal(params["sync"][n], params["async"][n])
+        for n in params["sync"])
+    assert identical, "sync vs async final params diverged"
+    out = {
+        "metric": "train_loop_async_steps_per_sec",
+        "value": results["async"]["steps_per_sec"],
+        "unit": "steps/sec",
+        "vs_baseline": None,
+        "speedup_vs_sync": round(
+            results["async"]["steps_per_sec"]
+            / results["sync"]["steps_per_sec"], 3),
+        "bit_identical_params": identical,
+        "sync": results["sync"],
+        "async": results["async"],
+    }
+    _attach_calibration(out, "train_loop")
+    print(json.dumps(out))
+
+
 def _timed_staged_steps(exe, prog, feed, loss, steps):
     """The one staged-timing methodology (warmup, chained async steps,
     final d2h readback) — shared by the headline path and BENCH_OVERLAP
@@ -842,6 +945,9 @@ def main():
     import jax
 
     import paddle_tpu as pt
+
+    if model == "train_loop":
+        return run_train_loop(batch, steps)
 
     if os.environ.get("BENCH_RAGGED") == "1":
         if model not in ("lstm", "nmt"):

@@ -18,6 +18,15 @@ Python file that builds the model on the default programs and exposes
 
 Commands:
   train       --config M.py [--num_passes N] [--save_dir D] [flags...]
+              notable flags for the pipelined loop (README "Training"):
+              --prefetch_to_device N  DevicePrefetcher queue depth
+                                      (default 2; 0 disables)
+              --sync_every N          host-sync cadence of the async step
+                                      loop (default: follow --log_period;
+                                      1 = fully synchronous legacy loop;
+                                      env PT_FLAGS_SYNC_EVERY)
+              --log_period N          print cost every N batches (reading
+                                      the lazy cost is itself a sync)
   merge_model --model_dir D --out O   (MergeModel.cpp parity: checkpoint
                                        params -> single deployable dir)
   serve       --model_dir D [--model name=dir ...] [--host H] [--port P]

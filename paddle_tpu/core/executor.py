@@ -280,6 +280,15 @@ class Executor:
     feed, fetch_list)`. Compilation is cached per (program version, feed
     shapes, fetch list)."""
 
+    # consulted by the Trainer's pipelined loop: the base executor wants
+    # the default DevicePrefetcher (host->device copies overlap compute)
+    # and its fetches can feed the jitted on-device metric accumulator.
+    # The ParallelExecutor overrides both — it owns input placement via
+    # _place_inputs, and its mesh-committed fetches cannot be folded into
+    # a single-device accumulator without a gather.
+    prefetch_by_default = True
+    device_metric_accumulation = True
+
     def __init__(self, place: Optional[Place] = None, donate_state: bool = False):
         self.place = place or default_place()
         # donate_state=True lets XLA reuse the parameter/optimizer-state
@@ -324,7 +333,14 @@ class Executor:
         fetch_list: Optional[Sequence] = None,
         scope: Optional[Scope] = None,
         return_numpy: bool = True,
+        as_numpy: Optional[bool] = None,
     ):
+        """as_numpy=False keeps fetches as device arrays so the run does
+        NOT fence XLA's async dispatch queue — the pipelined Trainer loop
+        reads them back only on its sync cadence. Default (None) follows
+        return_numpy (the reference fluid API name)."""
+        if as_numpy is None:
+            as_numpy = return_numpy
         program = program or default_main_program()
         feed = dict(feed or {})
         scope = scope or global_scope()
@@ -332,8 +348,13 @@ class Executor:
             v.name if isinstance(v, Variable) else v for v in (fetch_list or [])
         ]
 
-        # normalize feed values to jax-compatible arrays
+        # normalize feed values to jax-compatible arrays. Committed jax
+        # arrays (the DevicePrefetcher path puts every batch on device
+        # ahead of time) pass through untouched — re-wrapping them in
+        # jnp.asarray would re-hash/re-place each one every batch
         for k, v in feed.items():
+            if isinstance(v, jax.Array):
+                continue
             if isinstance(v, np.ndarray):
                 feed[k] = jnp.asarray(v)
 
@@ -397,7 +418,7 @@ class Executor:
             )
         for n, v in new_state.items():
             scope.set(n, v)
-        if return_numpy:
+        if as_numpy:
             fetches = [
                 np.asarray(f) if not isinstance(f, LoDArray) else f for f in fetches
             ]

@@ -72,6 +72,11 @@ class DevicePrefetcher:
     h2d transfer of batch N+1 overlaps the device compute of batch N —
     the single biggest win when the host link is slow.
 
+    Trainer.train runs its input through this by default
+    (FLAGS.prefetch_to_device, depth 2) on executors that don't own
+    input placement themselves; the committed arrays it yields then skip
+    Executor.run's per-feed jnp.asarray normalization entirely.
+
     Usage::
 
         for feed in DevicePrefetcher(reader, feeder, depth=2):

@@ -118,6 +118,22 @@ define_flag("step_guard", False,
             "counterpart of check_nan_inf's debug abort; README 'Fault "
             "tolerance')")
 define_flag("log_period", 100, "trainer: log every N batches")
+define_flag("sync_every", 0,
+            "trainer: host-sync cadence of the pipelined step loop — "
+            "materialize the on-device cost/metric accumulator every N "
+            "steps (env: PT_FLAGS_SYNC_EVERY). 1 = the fully synchronous "
+            "legacy loop (every step fences XLA's async dispatch queue); "
+            "0 = auto: follow log_period, except a StepGuard-armed run "
+            "keeps the exact per-step check unless a cadence is set "
+            "explicitly (PERF.md 'Async dispatch and the host-sync "
+            "budget')")
+define_flag("prefetch_to_device", 2,
+            "trainer: default DevicePrefetcher queue depth — batch N+1's "
+            "host->device transfer overlaps batch N's compute "
+            "(DataProvider.h:375 double-buffer parity). 0 disables; "
+            "Trainer.train(prefetch_to_device=...) overrides per run. "
+            "Executors that own input placement (ParallelExecutor) "
+            "ignore the default")
 define_flag("show_param_stats_period", 0,
             "trainer: dump per-parameter value/gradient stats every N "
             "batches (reference: TrainerInternal.cpp:81-109); 0 = off")

@@ -420,7 +420,14 @@ def save_checkpoint(
 
     sharded=True uses the orbax-style per-shard format (each process
     writes only shards it owns — no all-gather; see the sharded section
-    below) instead of the single gathered npz."""
+    below) instead of the single gathered npz.
+
+    Threading contract: serial allocation re-lists the directory, so
+    concurrent saves into one checkpoint_dir from MULTIPLE threads of a
+    process could race onto the same serial. The Trainer's background
+    checkpointing therefore funnels every save through ONE writer thread
+    (trainer._CheckpointWriter) and hands it a host snapshot scope —
+    this function itself never touches the device when given one."""
     serial = get_latest_checkpoint_serial(checkpoint_dir) + 1
     if sharded:
         import jax

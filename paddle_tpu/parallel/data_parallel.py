@@ -44,6 +44,13 @@ class ParallelExecutor(Executor):
     unless a Variable carries `.sharding` (a PartitionSpec) — e.g. a vocab-
     sharded embedding table (parallel/sharded_embedding.py)."""
 
+    # the Trainer must not single-device-prefetch feeds this executor
+    # will shard over the mesh, and its mesh-committed fetches cannot
+    # join the single-device jitted metric accumulator — the pipelined
+    # loop degrades to the per-step host accumulation path here
+    prefetch_by_default = False
+    device_metric_accumulation = False
+
     def __init__(
         self,
         mesh: Optional[Mesh] = None,

@@ -9,9 +9,16 @@ Reference surface:
 
 TPU mapping: host-side timers bracket whole jitted steps (per-op host
 timing is meaningless under fusion); deep kernel profiles come from
-`profiler()` which wraps jax.profiler.trace (XProf). Dispatch is async —
-put a host-side read of a result (e.g. `float(np.asarray(cost))`) inside
-the timed block so the timer measures device work, not enqueue time."""
+`profiler()` which wraps jax.profiler.trace (XProf). Dispatch is async,
+so what a timer measures depends on whether the block reads a result
+back: the pipelined trainer deliberately splits the two —
+`forwardBackward` brackets only the enqueue (tens of microseconds when
+the host is keeping ahead of the device), and `hostSync` brackets the
+periodic d2h readback of the on-device metric accumulator, which is
+where all device wait time surfaces. The host-blocked fraction of a run
+is hostSync.total / wall time (bench.py BENCH_MODEL=train_loop). To time
+device work in an ad-hoc block, read a result inside it (e.g.
+`float(np.asarray(cost))`) — otherwise the timer measures enqueue."""
 
 from __future__ import annotations
 

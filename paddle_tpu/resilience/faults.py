@@ -69,7 +69,10 @@ KNOWN_POINTS = {
     "ckpt.write",       # io.save_vars / sharded shard write, pre-publish
     "ckpt.meta",        # io.save_checkpoint, before the completion marker
     "reader.next",      # resilience.RetryReader, per delivered sample
-    "executor.step",    # trainer batch loop, before the jitted step
+    "executor.step",    # trainer batch loop, before the jitted step;
+                        # action=corrupt NaN-poisons the batch's first
+                        # floating feed slot (deterministic non-finite
+                        # injection for StepGuard chaos tests)
     "serving.predict",  # serving.ServingEngine.predict, inside the lock
 }
 

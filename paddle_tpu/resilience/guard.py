@@ -27,6 +27,15 @@ load-bearing part).
 The guard is plain host-side numpy over values the trainer already
 fetched — no extra device work, so its per-step overhead is noise
 (PERF.md "StepGuard overhead").
+
+Pipelined (async-dispatch) loop integration: the trainer folds an
+on-device non-finite flag into its jitted metric accumulator and calls
+`observe_window(n_good, n_bad)` on its host-sync cadence instead of
+`observe(cost)` per step — detection lags by at most one sync window,
+and the rollback machinery makes that lag safe (the poisoned steps are
+discarded wholesale). While the guard is hot (`in_cooldown()`: an open
+bad streak or a running LR cool-down) the trainer degrades to per-step
+syncs so recovery keeps the exact step-granular semantics.
 """
 
 from __future__ import annotations
@@ -94,6 +103,39 @@ class StepGuard:
             if self.cooldown_left == 0 and scope is not None:
                 self._restore_lr(scope)
         return True
+
+    def observe_window(self, n_good: int, n_bad: int, scope=None) -> bool:
+        """Cadence-sync variant of observe(): fold a whole window of
+        steps whose outcomes the host only now learned (the pipelined
+        loop's on-device non-finite counter, materialized every
+        sync_every steps). A window containing ANY non-finite step is
+        treated as a contiguous bad streak — with async dispatch the
+        poisoned update has long been applied, so the distinction
+        between 'one bad then good' and 'all bad' is moot: the params
+        are contaminated either way and rollback is the remedy.
+        Returns True iff the window was clean."""
+        if n_bad:
+            self.bad_streak += n_bad
+            self.skipped += n_bad
+            log.warning(
+                "StepGuard: %d non-finite step(s) in the last sync window "
+                "(streak %d/%d)", n_bad, self.bad_streak,
+                self.max_consecutive)
+            return False
+        if n_good:
+            self.bad_streak = 0
+            if self.cooldown_left > 0:
+                self.cooldown_left = max(0, self.cooldown_left - n_good)
+                if self.cooldown_left == 0 and scope is not None:
+                    self._restore_lr(scope)
+        return True
+
+    def in_cooldown(self) -> bool:
+        """True while the guard needs step-granular host syncs: an open
+        bad streak (rollback decision pending) or a running reduced-LR
+        cool-down window. The pipelined trainer checks this to drop from
+        cadence syncs to per-step syncs."""
+        return self.bad_streak > 0 or self.cooldown_left > 0
 
     def wants_rollback(self) -> bool:
         return self.bad_streak >= self.max_consecutive

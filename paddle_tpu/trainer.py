@@ -14,16 +14,34 @@ programs are `main.clone(for_test=True)`. Checkpoints capture the full
 persistable Scope slice (optimizer state included) plus reader position
 metadata, so preemption-resume continues mid-training (go/pserver
 checkpointing design parity, §5.3/§5.4 of SURVEY.md).
+
+Pipelined hot path (PERF.md "Async dispatch and the host-sync budget"):
+the step loop never reads a fetch back to host per step. Fetches stay as
+device arrays (`Executor.run(as_numpy=False)`), a jitted on-device
+accumulator folds cost/metrics/non-finite-count, and the host fences the
+dispatch queue only every `sync_every` steps (and at pass end). Batches
+arrive through a DevicePrefetcher by default, and checkpoint commits run
+on a background writer thread over a `jax.device_get` snapshot — the loop
+blocks only if the previous checkpoint is still in flight. EndIteration
+carries a lazy cost in cadence mode: handlers that format/compare it pay
+the sync, handlers that only look at ids pay nothing. The ONLY sanctioned
+`float(np.asarray(...))` sync points are `_host_read_step` /
+`_PassStats.sync` / `_LazyScalar.materialize` — a lint test greps the
+step loop for strays.
 """
 
 from __future__ import annotations
 
 import logging
+import queue
 import signal
 import threading
 import time
-from typing import Any, Callable, Dict, List, Optional, Sequence
+from functools import partial
+from typing import Any, Callable, Dict, Optional, Sequence
 
+import jax
+import jax.numpy as jnp
 import numpy as np
 
 from . import io
@@ -72,12 +90,263 @@ class BeginIteration:
 
 
 class EndIteration:
+    """cost/metrics are plain floats on per-step-sync cadences and
+    _LazyScalar wrappers otherwise — float()/format()/comparison/numpy
+    coercion materialize them transparently, so existing handlers keep
+    working; handlers that never touch them never fence dispatch."""
+
     def __init__(self, pass_id, batch_id, step, cost, metrics):
         self.pass_id = pass_id
         self.batch_id = batch_id
         self.step = step  # global step
         self.cost = cost
         self.metrics = metrics
+
+
+class _LazyScalar:
+    """A scalar fetch still living on device. Reading it (float, format,
+    str, comparison, numpy coercion) is a host sync — it fences the XLA
+    dispatch queue up to the step that produced it — so the pipelined
+    loop hands these to event handlers instead of eagerly syncing."""
+
+    __slots__ = ("_value", "_host", "_on_sync")
+
+    def __init__(self, value, on_sync: Optional[Callable] = None):
+        self._value = value
+        self._host: Optional[float] = None
+        self._on_sync = on_sync
+
+    def materialize(self) -> float:
+        if self._host is None:
+            if self._on_sync is not None:
+                self._on_sync()
+            self._host = float(np.asarray(self._value))
+            self._value = None  # drop the device ref once read
+        return self._host
+
+    def __float__(self):
+        return self.materialize()
+
+    def __format__(self, spec):
+        return format(self.materialize(), spec)
+
+    def __str__(self):
+        return str(self.materialize())
+
+    def __repr__(self):
+        if self._host is None:
+            return "<lazy device scalar (unread)>"
+        return repr(self._host)
+
+    def __array__(self, dtype=None):  # np.isfinite(event.cost) etc.
+        return np.asarray(self.materialize(), dtype=dtype)
+
+    def __eq__(self, other):
+        return self.materialize() == float(other)
+
+    def __lt__(self, other):
+        return self.materialize() < float(other)
+
+    def __le__(self, other):
+        return self.materialize() <= float(other)
+
+    def __gt__(self, other):
+        return self.materialize() > float(other)
+
+    def __ge__(self, other):
+        return self.materialize() >= float(other)
+
+    def __hash__(self):
+        return hash(self.materialize())
+
+    def __add__(self, other):
+        return self.materialize() + other
+
+    __radd__ = __add__
+
+    def __sub__(self, other):
+        return self.materialize() - other
+
+    def __rsub__(self, other):
+        return other - self.materialize()
+
+    def __mul__(self, other):
+        return self.materialize() * other
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, other):
+        return self.materialize() / other
+
+    def __rtruediv__(self, other):
+        return other / self.materialize()
+
+
+@partial(jax.jit, static_argnames="skip_nonfinite")
+def _accum_update(state, cost, metrics, skip_nonfinite):
+    """One on-device accumulator fold: O(1) tiny-op dispatch per step,
+    zero host work. skip_nonfinite (StepGuard armed) gates a non-finite
+    step's cost/metrics out of the pass stats, exactly as the legacy
+    loop's host-side skip did; the `bad` counter is what the guard reads
+    on its sync cadence."""
+    n, cost_sum, metric_sums, bad = state
+    c = jnp.reshape(jnp.asarray(cost, jnp.float32), ())
+    finite = jnp.isfinite(c)
+    good = finite if skip_nonfinite else jnp.asarray(True)
+    n = n + good.astype(jnp.int32)
+    cost_sum = cost_sum + jnp.where(good, c, 0.0)
+    metric_sums = [
+        m + jnp.where(good, jnp.reshape(jnp.asarray(v, jnp.float32), ()), 0.0)
+        for m, v in zip(metric_sums, metrics)
+    ]
+    bad = bad + (~finite).astype(jnp.int32)
+    return n, cost_sum, metric_sums, bad
+
+
+class _PassStats:
+    """Per-pass cost/metric accumulation with explicit host-sync points.
+
+    device=True (base Executor): state lives on device, `update` enqueues
+    one jitted fold, `sync` is THE d2h fence. device=False
+    (ParallelExecutor — mesh-committed fetches can't join a single-device
+    accumulator): every update materializes, i.e. the legacy per-step
+    behavior. Either way the host-side bookkeeping (steps seen / bad
+    seen) feeds the StepGuard's window observation."""
+
+    def __init__(self, n_metrics: int, skip_nonfinite: bool,
+                 device: bool = True, on_sync: Optional[Callable] = None):
+        self.device = device
+        self.skip_nonfinite = bool(skip_nonfinite)
+        self.on_sync = on_sync
+        self.steps = 0         # steps folded in
+        self.synced_steps = 0  # steps whose outcome the host has seen
+        self.synced_bad = 0
+        self.host = (0, 0.0, [0.0] * n_metrics, 0)  # (n, Σcost, Σm, bad)
+        if device:
+            z = jnp.zeros((), jnp.int32)
+            zf = jnp.zeros((), jnp.float32)
+            self.state = (z, zf, [zf] * n_metrics, z)
+
+    def update(self, cost, metrics) -> None:
+        self.steps += 1
+        if self.device:
+            self.state = _accum_update(
+                self.state, cost, list(metrics),
+                skip_nonfinite=self.skip_nonfinite)
+            return
+        # host path: one sync per step by construction
+        if self.on_sync is not None:
+            self.on_sync()
+        c = float(np.asarray(cost))
+        finite = bool(np.isfinite(c))
+        good = finite or not self.skip_nonfinite
+        n, cs, ms, bad = self.host
+        if good:
+            n += 1
+            cs += c
+            ms = [m + float(np.asarray(v)) for m, v in zip(ms, metrics)]
+        self.host = (n, cs, ms, bad + (0 if finite else 1))
+
+    def pending(self) -> int:
+        return self.steps - self.synced_steps
+
+    def note_observed(self, bad: bool) -> None:
+        """A per-step sync path already told the guard about this step —
+        advance the window markers so the next cadence sync doesn't
+        re-report it."""
+        self.synced_steps += 1
+        if bad:
+            self.synced_bad += 1
+
+    def sync(self):
+        """Materialize the accumulator (the sanctioned d2h fence) and
+        return (n_good, n_bad) for the window since the previous sync."""
+        if self.device:
+            if self.on_sync is not None:
+                self.on_sync()
+            n, cs, ms, bad = jax.device_get(self.state)
+            self.host = (int(n), float(cs), [float(m) for m in ms], int(bad))
+        delta_total = self.steps - self.synced_steps
+        # per-step observation tracks cost-only finiteness (mirroring the
+        # device counter); clamp so a grads-only bad verdict from the
+        # stats path can never push the window delta negative
+        delta_bad = max(0, self.host[3] - self.synced_bad)
+        delta_bad = min(delta_bad, delta_total)
+        self.synced_steps = self.steps
+        self.synced_bad = self.host[3]
+        return delta_total - delta_bad, delta_bad
+
+    def pass_metrics(self, metric_names: Sequence[str]) -> Dict[str, float]:
+        n, cost_sum, msums, _ = self.host
+        out = {"cost": cost_sum / n if n else float("nan")}
+        denom = max(n, 1)
+        for k, s in zip(metric_names, msums):
+            out[k] = s / denom
+        return out
+
+
+def _poison_feed(feed: Dict[str, Any]) -> Dict[str, Any]:
+    """faults `executor.step` action=corrupt: NaN-poison the first feed
+    slot with a floating dtype (deterministic non-finite injection — the
+    chaos-test counterpart of a bad batch / overflowed loss)."""
+    def _is_float(a):
+        return hasattr(a, "dtype") and np.issubdtype(
+            np.dtype(a.dtype), np.floating)
+
+    out = dict(feed)
+    for k in sorted(out):
+        if any(_is_float(l) for l in jax.tree_util.tree_leaves(out[k])):
+            out[k] = jax.tree_util.tree_map(
+                lambda a: a * np.nan if _is_float(a) else a, out[k])
+            return out
+    return out
+
+
+class _CheckpointWriter:
+    """Single background checkpoint committer.
+
+    The step loop hands it a host snapshot (already `jax.device_get`,
+    so the device is not involved) and keeps training while the
+    npz+sha256+atomic-rename commit — the existing io.save_checkpoint
+    machinery — runs on this thread. `submit` waits for the PREVIOUS
+    commit first: at most one snapshot is being written while the next
+    one is being captured (the double buffer), so checkpoint cadence can
+    never queue unbounded host copies. A failed commit surfaces on the
+    training thread at the next submit/drain."""
+
+    def __init__(self):
+        self._q: "queue.Queue" = queue.Queue(maxsize=1)
+        self._idle = threading.Event()
+        self._idle.set()
+        self._exc: Optional[BaseException] = None
+        self._thread: Optional[threading.Thread] = None
+
+    def _loop(self):
+        while True:
+            fn = self._q.get()
+            try:
+                fn()
+            except BaseException as e:  # surfaced on the training thread
+                self._exc = e
+            finally:
+                self._idle.set()
+
+    def submit(self, fn: Callable[[], Any]) -> None:
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._loop, daemon=True, name="ptpu-ckpt-writer")
+            self._thread.start()
+        self.drain()  # block only if the previous commit is in flight
+        self._idle.clear()
+        self._q.put(fn)
+
+    def drain(self) -> None:
+        """Wait until no commit is in flight; re-raise a failed one."""
+        self._idle.wait()
+        if self._exc is not None:
+            exc, self._exc = self._exc, None
+            raise RuntimeError(
+                "background checkpoint write failed") from exc
 
 
 class CheckpointConfig:
@@ -91,6 +360,7 @@ class CheckpointConfig:
         step_interval: int = 0,
         max_num_checkpoints: int = 3,
         sharded: bool = False,
+        background: bool = True,
     ):
         self.checkpoint_dir = checkpoint_dir
         self.epoch_interval = epoch_interval
@@ -101,6 +371,12 @@ class CheckpointConfig:
         # gathered npz would race across writers and cannot read
         # non-addressable arrays)
         self.sharded = sharded
+        # background=True hands the disk commit to a writer thread over a
+        # device_get snapshot, so the step loop stalls only for the d2h
+        # copy, not the serialization+fsync. Sharded/multi-process saves
+        # stay synchronous: their cross-process barriers must run on the
+        # thread every process is blocking on.
+        self.background = background
 
 
 class Trainer:
@@ -140,6 +416,12 @@ class Trainer:
         self.start_pass = 0
         self._resume_batch = 0  # first batch to run in the resumed pass
         self._initialized = False
+        self._ckpt_writer = _CheckpointWriter()
+        # host-sync accounting: every sanctioned d2h fence (per-step
+        # reads, cadence syncs, lazy-cost materializations) increments
+        # this — bench.py's train_loop microbench asserts the async loop
+        # fences strictly less often than the sync loop
+        self.host_sync_count = 0
 
     # -- lifecycle ---------------------------------------------------------
     def init(self) -> "Trainer":
@@ -168,6 +450,24 @@ class Trainer:
         """Callable from an event handler to end training (v2 trainer.stop)."""
         self._stop = True
 
+    # -- sync-cadence resolution -------------------------------------------
+    def _count_sync(self) -> None:
+        self.host_sync_count += 1
+
+    def _resolve_sync_every(self, log_interval: Optional[int]) -> int:
+        """Host-sync cadence of the step loop. Explicit `log_interval`
+        wins, then FLAGS.sync_every (PT_FLAGS_SYNC_EVERY), then auto:
+        a StepGuard-armed run keeps the exact per-step check (its tests
+        and semantics are step-granular), everything else follows
+        log_period — the cadence at which anyone looks at the numbers."""
+        if log_interval is not None:
+            return max(1, int(log_interval))
+        if FLAGS.sync_every > 0:
+            return int(FLAGS.sync_every)
+        if self.step_guard is not None:
+            return 1
+        return max(1, int(FLAGS.log_period))
+
     # -- training ----------------------------------------------------------
     def train(
         self,
@@ -177,20 +477,30 @@ class Trainer:
         event_handler: Optional[Callable] = None,
         fetch_metrics: Optional[Dict[str, Variable]] = None,
         test_reader: Optional[Callable] = None,
-        prefetch_to_device: int = 0,
+        prefetch_to_device: Optional[int] = None,
+        log_interval: Optional[int] = None,
     ) -> Dict[str, float]:
         """Pass/batch loop. Returns the final EndPass metrics dict.
 
-        prefetch_to_device > 0 enables the async double-buffered
-        host→device pipeline (DataProvider.h:375 parity) with that queue
-        depth — batch N+1's transfer overlaps batch N's compute.
+        prefetch_to_device enables the async double-buffered host→device
+        pipeline (DataProvider.h:375 parity) with that queue depth —
+        batch N+1's transfer overlaps batch N's compute. Default (None):
+        FLAGS.prefetch_to_device (2) on executors that don't own input
+        placement themselves; 0 disables.
+
+        log_interval sets the host-sync cadence: cost/metrics accumulate
+        on device and are read back every `log_interval` steps (and at
+        pass end). Default (None) resolves via FLAGS.sync_every /
+        log_period; 1 is the fully synchronous legacy loop.
 
         Preemption: while training runs (main thread only), SIGTERM and
         SIGINT are translated into finish-the-current-batch → emergency
         mid-pass checkpoint (when checkpoint_config is set) →
         PreemptedError; the CLI maps that to exit code 75 (EX_TEMPFAIL)
-        so schedulers reschedule instead of paging. Resume rides the
-        normal checkpoint machinery (`init()`)."""
+        so schedulers reschedule instead of paging. The background
+        checkpoint writer is drained before the error propagates, so the
+        emergency save is durable by exit 75. Resume rides the normal
+        checkpoint machinery (`init()`)."""
         if not self._initialized:
             self.init()
         self._stop = False
@@ -209,10 +519,18 @@ class Trainer:
         try:
             return self._train(reader, num_passes, feed_order,
                                event_handler, fetch_metrics, test_reader,
-                               prefetch_to_device)
+                               prefetch_to_device, log_interval)
         finally:
             for s, h in installed.items():
                 signal.signal(s, h)
+
+    # the ONLY per-step d2h fence, and deliberately not inlined in _train:
+    # the lint test asserts the step loop body contains no raw
+    # float(np.asarray(...)) readbacks outside the sanctioned helpers
+    def _host_read_step(self, cost_dev, metric_devs) -> tuple:
+        self._count_sync()
+        cost = float(np.asarray(cost_dev))
+        return cost, [float(np.asarray(v)) for v in metric_devs]
 
     def _train(
         self,
@@ -222,17 +540,28 @@ class Trainer:
         event_handler: Optional[Callable] = None,
         fetch_metrics: Optional[Dict[str, Variable]] = None,
         test_reader: Optional[Callable] = None,
-        prefetch_to_device: int = 0,
+        prefetch_to_device: Optional[int] = None,
+        log_interval: Optional[int] = None,
     ) -> Dict[str, float]:
         handler = event_handler or (lambda e: None)
         feeder = DataFeeder(feed_order) if feed_order is not None else None
         metric_items = sorted((fetch_metrics or {}).items())
+        metric_names = [k for k, _ in metric_items]
         fetch_list = [self.cost] + [v for _, v in metric_items]
         last_metrics: Dict[str, float] = {}
+        guard = self.step_guard
+        device_acc = getattr(self.exe, "device_metric_accumulation", True)
+        if prefetch_to_device is None:
+            prefetch_to_device = (
+                FLAGS.prefetch_to_device
+                if getattr(self.exe, "prefetch_by_default", True) else 0)
+        sync_every = self._resolve_sync_every(log_interval)
 
         for pass_id in range(self.start_pass, num_passes):
             handler(BeginPass(pass_id))
-            costs, metric_sums = [], np.zeros(len(metric_items))
+            acc = _PassStats(len(metric_items),
+                             skip_nonfinite=guard is not None,
+                             device=device_acc, on_sync=self._count_sync)
             skip_until = self._resume_batch
             self._resume_batch = 0  # only the resumed pass skips
             last_batch_id = -1
@@ -278,17 +607,19 @@ class Trainer:
                         if p.name in trained
                     ]
                     step_fetch += [grad_var_name(p) for p in stat_params]
-                faults.fire("executor.step", step=self.step)
+                if faults.fire("executor.step", step=self.step) == "corrupt":
+                    feed = _poison_feed(feed)
+                # enqueue only: fetches stay on device, the timer measures
+                # dispatch cost; device wait shows up under hostSync
                 with profiler.timer("forwardBackward"):
                     outs = self.exe.run(
                         self.main_program,
                         feed=feed,
                         fetch_list=step_fetch,
                         scope=self.scope,
+                        as_numpy=False,
                     )
-                    # the d2h read of the cost fences async dispatch, so the
-                    # timer measures device work, not enqueue time
-                    cost = float(np.asarray(outs[0]))
+                cost_dev = outs[0]
                 grads = None
                 if want_stats:
                     # reference: TrainerInternal.cpp:81-109 param stats dump
@@ -299,39 +630,73 @@ class Trainer:
                     ).items():
                         print(f"  param {pname}: " + ", ".join(
                             f"{k}={v:.4g}" for k, v in st.items()))
-                guard = self.step_guard
-                if guard is not None and not guard.observe(
-                        cost, grads, scope=self.scope):
-                    # non-finite step: it is consumed (step counter,
-                    # events) but contributes nothing to the pass stats
-                    # and NEVER triggers the checkpoint cadence —
-                    # poisoned params must not become the "last good
-                    # checkpoint" a rollback would then restore
+                metric_devs = outs[1:]
+                acc.update(cost_dev, metric_devs)
+                # per-step sync: legacy cadence, a hot StepGuard (open
+                # streak / cool-down), or a stats step (it prints anyway)
+                per_step = (sync_every == 1 or want_stats
+                            or (guard is not None and guard.in_cooldown()))
+                if per_step:
+                    with profiler.timer("hostSync"):
+                        cost, metric_vals = self._host_read_step(
+                            cost_dev, metric_devs)
+                    if guard is not None:
+                        ok = guard.observe(cost, grads, scope=self.scope)
+                        acc.note_observed(not np.isfinite(cost))
+                        if not ok:
+                            # non-finite step: it is consumed (step counter,
+                            # events) but contributes nothing to the pass
+                            # stats (the accumulator gated it out) and NEVER
+                            # triggers the checkpoint cadence — poisoned
+                            # params must not become the "last good
+                            # checkpoint" a rollback would then restore
+                            self.step += 1
+                            handler(EndIteration(
+                                pass_id, batch_id, self.step, cost, {}))
+                            if guard.wants_rollback():
+                                self._rollback(guard)
+                            continue
+                    batch_metrics = dict(zip(metric_names, metric_vals))
                     self.step += 1
                     handler(EndIteration(
-                        pass_id, batch_id, self.step, cost, {}))
-                    if guard.wants_rollback():
-                        self._rollback(guard)
-                    continue
-                batch_metrics = {
-                    k: float(np.asarray(v))
-                    for (k, _), v in zip(metric_items, outs[1:])
-                }
-                costs.append(cost)
-                metric_sums += np.array(
-                    [batch_metrics[k] for k, _ in metric_items]
-                ) if metric_items else 0
-                self.step += 1
-                handler(
-                    EndIteration(pass_id, batch_id, self.step, cost, batch_metrics)
-                )
+                        pass_id, batch_id, self.step, cost, batch_metrics))
+                else:
+                    self.step += 1
+                    lazy_cost = _LazyScalar(cost_dev, self._count_sync)
+                    handler(EndIteration(
+                        pass_id, batch_id, self.step, lazy_cost,
+                        {k: _LazyScalar(v, self._count_sync)
+                         for k, v in zip(metric_names, metric_devs)}))
+                    if acc.pending() >= sync_every:
+                        with profiler.timer("hostSync"):
+                            n_good, n_bad = acc.sync()
+                        if guard is not None and not guard.observe_window(
+                                n_good, n_bad, scope=self.scope):
+                            if guard.wants_rollback():
+                                self._rollback(guard)
+                            continue  # dirty window: no checkpoint either
                 cc = self.checkpoint_config
                 if cc and cc.step_interval and self.step % cc.step_interval == 0:
+                    if guard is not None and acc.pending():
+                        # the cadence landed between syncs: learn the
+                        # window's outcome before persisting anything
+                        with profiler.timer("hostSync"):
+                            n_good, n_bad = acc.sync()
+                        if not guard.observe_window(
+                                n_good, n_bad, scope=self.scope):
+                            if guard.wants_rollback():
+                                self._rollback(guard)
+                            continue
                     self._save_checkpoint(pass_id, batch_id=batch_id)
-            n = max(len(costs), 1)
-            last_metrics = {"cost": float(np.mean(costs)) if costs else float("nan")}
-            for i, (k, _) in enumerate(metric_items):
-                last_metrics[k] = float(metric_sums[i] / n)
+            # pass end: materialize whatever the cadence hasn't yet
+            if acc.pending() or acc.device:
+                with profiler.timer("hostSync"):
+                    n_good, n_bad = acc.sync()
+                if guard is not None and not guard.observe_window(
+                        n_good, n_bad, scope=self.scope):
+                    if guard.wants_rollback():
+                        self._rollback(guard)
+            last_metrics = acc.pass_metrics(metric_names)
             if test_reader is not None and self._preempt_signal is None:
                 # a preempted run skips the evaluation pass: the grace
                 # window between SIGTERM and SIGKILL is for the
@@ -355,6 +720,10 @@ class Trainer:
                 break
             if cc and cc.epoch_interval and (pass_id + 1) % cc.epoch_interval == 0:
                 self._save_checkpoint(pass_id)
+        # every submitted checkpoint must be durable before we report
+        # completion — and before exit 75 hands the job back to the
+        # scheduler (the emergency save is the resume point)
+        self._ckpt_writer.drain()
         if self._preempt_signal is not None:
             try:
                 signame = signal.Signals(self._preempt_signal).name
@@ -397,6 +766,9 @@ class Trainer:
         from the current reader position — the poisoned batch window is
         effectively skipped, which is the production trade the guard
         documents."""
+        # an in-flight background save must land before we list serials:
+        # it may BE the checkpoint we are about to restore
+        self._ckpt_writer.drain()
         cc = self.checkpoint_config
         serial = (io.get_latest_checkpoint_serial(cc.checkpoint_dir)
                   if cc else -1)
@@ -412,8 +784,6 @@ class Trainer:
 
     # -- checkpointing ------------------------------------------------------
     def _save_checkpoint(self, pass_id: int, batch_id: Optional[int] = None) -> None:
-        import jax
-
         cc = self.checkpoint_config
         args = {"pass_id": pass_id, "step": self.step, "time": time.time()}
         if batch_id is not None:
@@ -434,14 +804,39 @@ class Trainer:
                     "to silence this)"
                 )
             sharded = True
-        io.save_checkpoint(
+        if sharded or not getattr(cc, "background", True):
+            # sharded saves barrier across processes — every process must
+            # actually be executing the save, so it stays on this thread
+            io.save_checkpoint(
+                cc.checkpoint_dir,
+                trainer_args=args,
+                main_program=self.main_program,
+                scope=self.scope,
+                max_num_checkpoints=cc.max_num_checkpoints,
+                sharded=sharded,
+            )
+            return
+        # background: snapshot params to host NOW (the values of THIS
+        # step — device_get waits for the dispatch queue, not the disk),
+        # then hand the npz+sha256+atomic-rename commit to the writer
+        with profiler.timer("checkpointSnapshot"):
+            names = sorted(
+                v.name for v in self.main_program.persistables()
+                if self.scope.has(v.name)
+            )
+            snap = jax.device_get({n: self.scope.get(n) for n in names})
+        host_scope = Scope()
+        for n, v in snap.items():
+            host_scope.set(n, v)
+        program, max_keep = self.main_program, cc.max_num_checkpoints
+        self._ckpt_writer.submit(lambda: io.save_checkpoint(
             cc.checkpoint_dir,
             trainer_args=args,
-            main_program=self.main_program,
-            scope=self.scope,
-            max_num_checkpoints=cc.max_num_checkpoints,
-            sharded=sharded,
-        )
+            main_program=program,
+            scope=host_scope,
+            max_num_checkpoints=max_keep,
+            sharded=False,
+        ))
 
     def save_params(self, dirname: str) -> None:
         io.save_params(dirname, self.main_program, self.scope)

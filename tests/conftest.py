@@ -63,3 +63,19 @@ def fresh_state():
 
     pt.reset()
     yield
+
+
+@pytest.fixture(params=["sync", "async"])
+def sync_mode(request):
+    """Parametrize a trainer test over both host-sync modes of the
+    pipelined step loop without duplicating the body: "sync" forces the
+    legacy per-step readback (sync_every=1), "async" a coarse cadence so
+    the on-device accumulator / lazy-cost path is what actually runs.
+    The two must be observably identical — that equivalence IS the
+    contract the parametrization enforces across tier-1."""
+    from paddle_tpu.flags import FLAGS
+
+    saved = FLAGS.sync_every
+    FLAGS.sync_every = 1 if request.param == "sync" else 64
+    yield request.param
+    FLAGS.sync_every = saved

@@ -106,7 +106,7 @@ def test_checkpoint_rotation_and_resume(tmp_path):
     assert sorted(kept) == ["checkpoint_3", "checkpoint_4"]
 
 
-def test_trainer_events_convergence_and_test_program():
+def test_trainer_events_convergence_and_test_program(sync_mode):
     x, y, pred, loss = _build_regression()
     acc_like = pt.layers.mean(pt.layers.square_error_cost(pred, y))
     pt.optimizer.SGD(learning_rate=0.05).minimize(loss)
@@ -172,7 +172,7 @@ def test_shared_param_shape_conflict_rejected():
         pt.layers.embedding(x, size=[50, 16], param_attr="shared_w")
 
 
-def test_trainer_midpass_resume(tmp_path):
+def test_trainer_midpass_resume(tmp_path, sync_mode):
     d = str(tmp_path / "ck")
     x, y, pred, loss = _build_regression()
     pt.optimizer.SGD(learning_rate=0.05).minimize(loss)
@@ -318,7 +318,7 @@ def test_device_prefetcher_with_feeder_and_training():
     assert np.mean(losses[-6:]) < np.mean(losses[:6])
 
 
-def test_trainer_prefetch_to_device():
+def test_trainer_prefetch_to_device(sync_mode):
     x = pt.layers.data("x", shape=[4])
     y = pt.layers.data("y", shape=[1])
     pred = pt.layers.fc(x, size=1)
