@@ -25,6 +25,12 @@ Commands:
                                       loop (default: follow --log_period;
                                       1 = fully synchronous legacy loop;
                                       env PT_FLAGS_SYNC_EVERY)
+              --scan_window K         fuse K steps into ONE compiled
+                                      lax.scan window: 1 host dispatch
+                                      per K steps, syncs at window edges
+                                      only (default 0 = per-step loop;
+                                      env PT_FLAGS_SCAN_WINDOW; single-
+                                      device executors only)
               --log_period N          print cost every N batches (reading
                                       the lazy cost is itself a sync)
   merge_model --model_dir D --out O   (MergeModel.cpp parity: checkpoint

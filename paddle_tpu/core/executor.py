@@ -109,6 +109,31 @@ def reset_global_scope() -> None:
     _global_scope = Scope()
 
 
+def accum_fold(state, cost, metrics, skip_nonfinite):
+    """One on-device cost/metric accumulator fold — THE shared definition
+    of the pass-stats math. The Trainer's per-step jitted `_accum_update`
+    and the windowed executor's in-scan fold both call this, so the two
+    cadences cannot drift numerically (the fixed-seed A/B demands equal
+    pass metrics, not just equal params).
+
+    state: (n_good, cost_sum, [metric_sums...], n_bad) — int32/float32
+    scalars. skip_nonfinite (StepGuard armed) gates a non-finite step's
+    cost/metrics out of the stats; the `bad` counter is what the guard
+    reads on its sync cadence."""
+    n, cost_sum, metric_sums, bad = state
+    c = jnp.reshape(jnp.asarray(cost, jnp.float32), ())
+    finite = jnp.isfinite(c)
+    good = finite if skip_nonfinite else jnp.asarray(True)
+    n = n + good.astype(jnp.int32)
+    cost_sum = cost_sum + jnp.where(good, c, 0.0)
+    metric_sums = [
+        m + jnp.where(good, jnp.reshape(jnp.asarray(v, jnp.float32), ()), 0.0)
+        for m, v in zip(metric_sums, metrics)
+    ]
+    bad = bad + (~finite).astype(jnp.int32)
+    return n, cost_sum, metric_sums, bad
+
+
 def _feed_signature(feed: Dict[str, Any]):
     sig = []
     for k in sorted(feed):
@@ -288,6 +313,10 @@ class Executor:
     # a single-device accumulator without a gather.
     prefetch_by_default = True
     device_metric_accumulation = True
+    # run_window (K fused steps under one lax.scan) assumes single-device
+    # carries; the ParallelExecutor disables it until the window path is
+    # explicitly threaded through the mesh (ISSUE 6 scope note)
+    scan_window_supported = True
 
     def __init__(self, place: Optional[Place] = None, donate_state: bool = False):
         self.place = place or default_place()
@@ -308,6 +337,37 @@ class Executor:
     # -- subclass hooks (ParallelExecutor overrides these) -------------
     def _cache_key_prefix(self) -> tuple:
         return ()
+
+    @staticmethod
+    def _program_trace_key(program: Program) -> tuple:
+        """Everything program-side that affects the trace — shared by the
+        per-step and windowed compile caches."""
+        return (
+            id(program),
+            program.version,
+            program.amp_dtype,
+            program.remat_policy,
+            # trace-affecting flags (all feed fused-kernel dispatch)
+            FLAGS.use_fused_rnn,
+            FLAGS.fused_rnn_interpret,
+            FLAGS.use_fused_attention,
+            FLAGS.fused_attention_interpret,
+            FLAGS.fused_attention_seq_fwd,
+            FLAGS.fused_attention_seq_bwd,
+            FLAGS.use_fused_conv,
+            FLAGS.fused_conv_pallas,
+            FLAGS.fused_conv_interpret,
+            FLAGS.fused_conv_dot_max_n,
+            FLAGS.stacked_lstm_single_scan,
+            # every trace-affecting kernel-config source (forced
+            # overrides, legacy env knobs like PT_ATTN_BBLK, the loaded
+            # tuned table) collapses into one fingerprint: a tuning
+            # sweep flipping ANY knob on a live Executor re-traces
+            # instead of silently reusing the stale tile choice, and
+            # future knobs invalidate the cache without touching this
+            # file (tune/overrides.py)
+            _tune_fingerprint(),
+        )
 
     def _compile(self, program: Program, feed, fetch_names, persist_names):
         """Build + wrap the traced block walk. Base: plain jax.jit."""
@@ -363,31 +423,7 @@ class Executor:
             for v in program.persistables()
             if scope.has(v.name)
         )
-        key = self._cache_key_prefix() + (
-            id(program),
-            program.version,
-            program.amp_dtype,
-            program.remat_policy,
-            # trace-affecting flags (all feed fused-kernel dispatch)
-            FLAGS.use_fused_rnn,
-            FLAGS.fused_rnn_interpret,
-            FLAGS.use_fused_attention,
-            FLAGS.fused_attention_interpret,
-            FLAGS.fused_attention_seq_fwd,
-            FLAGS.fused_attention_seq_bwd,
-            FLAGS.use_fused_conv,
-            FLAGS.fused_conv_pallas,
-            FLAGS.fused_conv_interpret,
-            FLAGS.fused_conv_dot_max_n,
-            FLAGS.stacked_lstm_single_scan,
-            # every trace-affecting kernel-config source (forced
-            # overrides, legacy env knobs like PT_ATTN_BBLK, the loaded
-            # tuned table) collapses into one fingerprint: a tuning
-            # sweep flipping ANY knob on a live Executor re-traces
-            # instead of silently reusing the stale tile choice, and
-            # future knobs invalidate the cache without touching this
-            # file (tune/overrides.py)
-            _tune_fingerprint(),
+        key = self._cache_key_prefix() + self._program_trace_key(program) + (
             _feed_signature(feed),
             tuple(fetch_names),
             tuple(persist_names),
@@ -454,7 +490,10 @@ class Executor:
         return state, feed, seed
 
     # ------------------------------------------------------------------
-    def _build(self, program: Program, feed_names, fetch_names, persist_names):
+    def _raw_step(self, program: Program, fetch_names, persist_names):
+        """The traced block walk as a pure function of (state, feed,
+        seed) — the unit both `_build` (one jitted step) and
+        `_build_window` (K steps under one lax.scan) compile."""
         runner = _BlockRunner(program)
         all_persist = {v.name for v in program.persistables()}
 
@@ -474,5 +513,132 @@ class Executor:
             }
             return fetches, new_state
 
+        return raw
+
+    def _build(self, program: Program, feed_names, fetch_names, persist_names):
         donate = (0,) if self.donate_state else ()
-        return jax.jit(raw, donate_argnums=donate)
+        return jax.jit(
+            self._raw_step(program, fetch_names, persist_names),
+            donate_argnums=donate,
+        )
+
+    # -- windowed (multi-step fused) execution -------------------------
+    def _build_window(self, program: Program, fetch_names, persist_names,
+                      skip_nonfinite: bool, with_acc: bool):
+        """Compile K training steps into ONE program: a lax.scan of the
+        traced step over a leading window axis of the feed, with the
+        persistable state AND the on-device metric accumulator riding in
+        the scan carry. One host dispatch per window instead of K — the
+        ISSUE 6 answer to PERF.md's per-step dispatch floor.
+
+        Persistables that first materialize inside the step (rare: the
+        usual flow initializes everything in startup) cannot join the
+        carry (its pytree structure is fixed before the first iteration),
+        so they ride the stacked scan outputs and the caller keeps the
+        last step's value."""
+        raw = self._raw_step(program, fetch_names, persist_names)
+        skip = bool(skip_nonfinite)
+
+        def win(state, feeds, seeds, acc):
+            def body(carry, xs):
+                st, ac = carry
+                feed_t, seed_t = xs
+                fetches, new_state = raw(st, feed_t, seed_t)
+                if with_acc:
+                    ac = accum_fold(ac, fetches[0], list(fetches[1:]), skip)
+                extras = {n: v for n, v in new_state.items() if n not in st}
+                st = {n: new_state.get(n, v) for n, v in st.items()}
+                return (st, ac), (fetches, extras)
+
+            (state, acc), (ys, extras) = jax.lax.scan(
+                body, (state, acc), (feeds, seeds))
+            return ys, state, acc, extras
+
+        return jax.jit(win)
+
+    def run_window(
+        self,
+        program: Optional[Program] = None,
+        feed: Optional[Dict[str, Any]] = None,
+        fetch_list: Optional[Sequence] = None,
+        scope: Optional[Scope] = None,
+        acc_state=None,
+        skip_nonfinite: bool = False,
+    ):
+        """Run K fused training steps in one dispatch.
+
+        feed values are stacked along a leading window axis (K = the
+        leading dim, same step-level signature for every slice — the
+        DevicePrefetcher's window mode builds these). acc_state, when
+        given, is the on-device accumulator tuple (`accum_fold` layout,
+        fetch_list[0] must be the cost) carried INSIDE the scan; the
+        updated accumulator is returned without any host sync.
+
+        Returns (ys, acc_out): ys aligned with fetch_list, each a device
+        array with leading axis K (per-step values — still async; reading
+        them is the caller's sync decision)."""
+        program = program or default_main_program()
+        feed = dict(feed or {})
+        scope = scope or global_scope()
+        fetch_names = [
+            v.name if isinstance(v, Variable) else v for v in (fetch_list or [])
+        ]
+        if acc_state is not None and not fetch_names:
+            raise ValueError(
+                "run_window with acc_state needs fetch_list[0] = cost")
+        for k, v in feed.items():
+            if isinstance(v, jax.Array):
+                continue
+            if isinstance(v, np.ndarray):
+                feed[k] = jnp.asarray(v)
+        leaves = jax.tree_util.tree_leaves(feed)
+        if not leaves:
+            raise ValueError("run_window needs at least one feed slot")
+        k_steps = int(leaves[0].shape[0])
+        persist_names = sorted(
+            v.name for v in program.persistables() if scope.has(v.name)
+        )
+        key = self._cache_key_prefix() + self._program_trace_key(program) + (
+            "scan_window",
+            bool(skip_nonfinite),
+            acc_state is not None,
+            _feed_signature(feed),  # window size K lives in the leading dim
+            tuple(fetch_names),
+            tuple(persist_names),
+        )
+        cached = self._cache.get(key)
+        if cached is None:
+            self.cache_stats["misses"] += 1
+            fn = self._build_window(
+                program, fetch_names, persist_names,
+                skip_nonfinite, acc_state is not None)
+            self._cache[key] = (program, fn)
+        else:
+            self.cache_stats["hits"] += 1
+            fn = cached[1]
+
+        state = {n: scope.get(n) for n in persist_names}
+        # commit carries to THE device before the call: jit specializes
+        # its executable on input shardings, so an uncommitted leaf (the
+        # startup outputs on the first window, a fresh pass's accumulator
+        # zeros) would silently double-compile every window program. A
+        # device_put of an already-resident array is a cheap no-copy.
+        state = jax.device_put(state, self.place.device)
+        if acc_state is not None:
+            acc_state = jax.device_put(acc_state, self.place.device)
+        seeds = jnp.asarray(
+            [self._draw_seed(program) for _ in range(k_steps)],
+            dtype=jnp.uint32)
+        with self._device_context(), self._trace_context():
+            ys, new_state, acc_out, extras = fn(state, feed, seeds, acc_state)
+        if FLAGS.check_nan_inf:
+            _check_finite(
+                {**new_state, **{n: f for n, f in zip(fetch_names, ys)}}
+            )
+        for n, v in new_state.items():
+            scope.set(n, v)
+        for n, v in extras.items():
+            # stacked K copies of a step-created persistable: keep the
+            # last step's value (what the step loop's scope would hold)
+            scope.set(n, jax.tree_util.tree_map(lambda a: a[-1], v))
+        return ys, acc_out

@@ -61,6 +61,46 @@ class DataFeeder:
         return out
 
 
+class FeedWindow:
+    """K device-committed batches stacked along a leading window axis —
+    the unit the windowed (lax.scan) training loop dispatches. `k` may be
+    short of the configured window for the ragged tail of a pass (or a
+    feed-signature change mid-stream); Executor.run_window compiles one
+    extra program per distinct k, which the jit cache absorbs."""
+
+    __slots__ = ("feed", "k")
+
+    def __init__(self, feed, k: int):
+        self.feed = feed
+        self.k = int(k)
+
+    def slice(self, i: int):
+        """One step's feed as a window of 1 (keeps the leading axis) —
+        the guard-hot fallback runs these for step-granular recovery."""
+        import jax
+
+        return {
+            name: jax.tree_util.tree_map(lambda a: a[i:i + 1], v)
+            for name, v in self.feed.items()
+        }
+
+
+def _stack_feeds(feeds):
+    """Stack K same-signature feed dicts to a leading window axis. The
+    leaves are already device-committed, so the stack itself is one
+    dispatched device op (issued from the prefetch thread — it overlaps
+    the training window in flight)."""
+    import jax
+    import jax.numpy as jnp
+
+    stacked = {
+        name: jax.tree_util.tree_map(
+            lambda *xs: jnp.stack(xs), *(f[name] for f in feeds))
+        for name in feeds[0]
+    }
+    return FeedWindow(stacked, len(feeds))
+
+
 class DevicePrefetcher:
     """Async double-buffered host→device pipeline.
 
@@ -83,11 +123,22 @@ class DevicePrefetcher:
             exe.run(prog, feed=feed, ...)
     """
 
-    def __init__(self, reader, feeder=None, depth: int = 2, device=None):
+    window = 0  # see __init__
+
+    def __init__(self, reader, feeder=None, depth: int = 2, device=None,
+                 window: int = 0):
         self.reader = reader
         self.feeder = feeder
         self.depth = max(1, int(depth))
         self.device = device
+        # window > 0: group consecutive same-signature batches and yield
+        # FeedWindow objects of up to `window` stacked batches instead of
+        # single feed dicts (the scan-window trainer path). depth then
+        # counts windows, so the effective prefetch depth in batches is
+        # depth*window >= window — the "auto-raised to >= K" guarantee.
+        # A signature change (e.g. a LoD bucket overflow) or the end of
+        # the pass flushes a partial window.
+        self.window = max(0, int(window))
 
     def __iter__(self):
         import queue as _queue
@@ -125,6 +176,9 @@ class DevicePrefetcher:
             return jax.device_put(v, target)
 
         def produce():
+            from ..core.executor import _feed_signature
+
+            buf, sig = [], None
             try:
                 for batch in self.reader():
                     if stop.is_set():
@@ -133,7 +187,22 @@ class DevicePrefetcher:
                     feed = {
                         k: jax.tree.map(put, v) for k, v in feed.items()
                     }
-                    q.put(feed)
+                    if not self.window:
+                        q.put(feed)
+                        continue
+                    s = _feed_signature(feed)
+                    if buf and s != sig:
+                        # shape change mid-stream: flush the partial
+                        # window so every window stays one compiled shape
+                        q.put(_stack_feeds(buf))
+                        buf = []
+                    sig = s
+                    buf.append(feed)
+                    if len(buf) == self.window:
+                        q.put(_stack_feeds(buf))
+                        buf = []
+                if buf:  # ragged tail window at pass end
+                    q.put(_stack_feeds(buf))
                 q.put(END)
             except BaseException as e:  # surface reader errors to consumer
                 q.put((ERR, e))

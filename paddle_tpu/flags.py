@@ -127,6 +127,19 @@ define_flag("sync_every", 0,
             "keeps the exact per-step check unless a cadence is set "
             "explicitly (PERF.md 'Async dispatch and the host-sync "
             "budget')")
+define_flag("scan_window", 0,
+            "trainer: fuse K training steps into ONE jitted lax.scan "
+            "program over a device-resident window of K stacked batches "
+            "(env: PT_FLAGS_SCAN_WINDOW, CLI --scan_window). One host "
+            "dispatch per window instead of K — removes, not just hides, "
+            "the per-step dispatch floor PERF.md measures; the on-device "
+            "metric accumulator and non-finite counter ride inside the "
+            "scan carry and sync only at window edges. 0 = off (the "
+            "per-step pipelined loop); requires an executor with "
+            "scan_window_supported (the mesh ParallelExecutor is not, "
+            "yet). Checkpoint cadence and StepGuard detection quantize "
+            "to window boundaries (PERF.md 'Breaking the dispatch "
+            "floor')")
 define_flag("prefetch_to_device", 2,
             "trainer: default DevicePrefetcher queue depth — batch N+1's "
             "host->device transfer overlaps batch N's compute "

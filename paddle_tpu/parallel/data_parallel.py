@@ -50,6 +50,12 @@ class ParallelExecutor(Executor):
     # loop degrades to the per-step host accumulation path here
     prefetch_by_default = False
     device_metric_accumulation = False
+    # run_window's lax.scan carries single-device state and stacked
+    # committed feeds; neither survives the mesh's explicit sharded
+    # placement (_place_inputs) without threading shardings through the
+    # scan carry — the Trainer falls back to the per-step loop here
+    # (loudly) until the window path is mesh-aware (ROADMAP item 3 note)
+    scan_window_supported = False
 
     def __init__(
         self,
@@ -203,7 +209,7 @@ class ParallelExecutor(Executor):
         return (self._seed_base + self._seed_calls) % (2**31 - 1)
 
     def run(self, program=None, feed=None, fetch_list=None, scope=None,
-            return_numpy=True):
+            return_numpy=True, as_numpy=None):
         """Init-style programs (they CREATE persistables the scope does
         not hold yet) cannot be mesh-compiled — the output tree would
         have to declare shardings for values that don't exist — so the
@@ -220,7 +226,8 @@ class ParallelExecutor(Executor):
         if creates_new and not feed and not fetch_list:
             return self.run_startup(prog, scope=scope_)
         return super().run(prog, feed=feed, fetch_list=fetch_list,
-                           scope=scope_, return_numpy=return_numpy)
+                           scope=scope_, return_numpy=return_numpy,
+                           as_numpy=as_numpy)
 
     def _cache_key_prefix(self) -> tuple:
         return ("par", id(self.mesh))

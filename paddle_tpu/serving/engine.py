@@ -162,6 +162,14 @@ class ServingEngine:
         self._seen_buckets: Dict[tuple, int] = {}
         self.cache_hits = 0
         self.cache_misses = 0
+        # uniform dispatch/sync accounting (Trainer.dispatches_total /
+        # syncs_total parity): every predict issues one XLA dispatch and
+        # — because it returns numpy — pays exactly one d2h fence. bench
+        # and the Prometheus surface read the SAME counters the trainer
+        # A/B tests assert on, so "how often does the host wait" means
+        # one thing across training and serving.
+        self.dispatches_total = 0
+        self.syncs_total = 0
         self._lat = self.metrics.histogram(
             "engine_run_seconds",
             help="end-to-end ServingEngine.predict latency (pad + XLA "
@@ -285,6 +293,15 @@ class ServingEngine:
                     "compile_cache_misses_total",
                     help="requests that triggered a bucket compile")
             self._seen_buckets[key] = self._seen_buckets.get(key, 0) + 1
+            self.dispatches_total += 1
+            self.syncs_total += 1  # numpy fetches fence the dispatch queue
+            self.metrics.counter_inc(
+                "dispatches_total",
+                help="XLA program dispatches issued by this engine")
+            self.metrics.counter_inc(
+                "syncs_total",
+                help="host d2h fences paid by this engine (numpy fetch "
+                     "per predict)")
             outs = self.exe.run(
                 self.program,
                 feed=padded,
@@ -376,6 +393,8 @@ class ServingEngine:
                 "cache_misses": self.cache_misses,
                 "hit_rate": self.hit_rate(),
                 "compiled_programs": self.compiled_programs(),
+                "dispatches_total": self.dispatches_total,
+                "syncs_total": self.syncs_total,
                 "executor_cache": dict(self.exe.cache_stats),
                 "buckets": {
                     "batch": list(self.policy.batch_buckets),

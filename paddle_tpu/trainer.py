@@ -46,7 +46,7 @@ import numpy as np
 
 from . import io
 from . import profiler
-from .core.executor import Executor, Scope, global_scope
+from .core.executor import Executor, Scope, accum_fold, global_scope
 from .flags import FLAGS
 from .core.place import Place
 from .core.program import (
@@ -109,18 +109,25 @@ class _LazyScalar:
     dispatch queue up to the step that produced it — so the pipelined
     loop hands these to event handlers instead of eagerly syncing."""
 
-    __slots__ = ("_value", "_host", "_on_sync")
+    __slots__ = ("_value", "_host", "_on_sync", "_index")
 
-    def __init__(self, value, on_sync: Optional[Callable] = None):
+    def __init__(self, value, on_sync: Optional[Callable] = None,
+                 index: Optional[int] = None):
         self._value = value
         self._host: Optional[float] = None
         self._on_sync = on_sync
+        # index: the scalar is row `index` of a stacked per-window fetch.
+        # The slice happens at materialize time, NOT construction — an
+        # eager ys[i] would dispatch one device op per step and hand the
+        # scan window's dispatch saving right back
+        self._index = index
 
     def materialize(self) -> float:
         if self._host is None:
             if self._on_sync is not None:
                 self._on_sync()
-            self._host = float(np.asarray(self._value))
+            v = np.asarray(self._value)
+            self._host = float(v if self._index is None else v[self._index])
             self._value = None  # drop the device ref once read
         return self._host
 
@@ -182,25 +189,11 @@ class _LazyScalar:
         return other / self.materialize()
 
 
-@partial(jax.jit, static_argnames="skip_nonfinite")
-def _accum_update(state, cost, metrics, skip_nonfinite):
-    """One on-device accumulator fold: O(1) tiny-op dispatch per step,
-    zero host work. skip_nonfinite (StepGuard armed) gates a non-finite
-    step's cost/metrics out of the pass stats, exactly as the legacy
-    loop's host-side skip did; the `bad` counter is what the guard reads
-    on its sync cadence."""
-    n, cost_sum, metric_sums, bad = state
-    c = jnp.reshape(jnp.asarray(cost, jnp.float32), ())
-    finite = jnp.isfinite(c)
-    good = finite if skip_nonfinite else jnp.asarray(True)
-    n = n + good.astype(jnp.int32)
-    cost_sum = cost_sum + jnp.where(good, c, 0.0)
-    metric_sums = [
-        m + jnp.where(good, jnp.reshape(jnp.asarray(v, jnp.float32), ()), 0.0)
-        for m, v in zip(metric_sums, metrics)
-    ]
-    bad = bad + (~finite).astype(jnp.int32)
-    return n, cost_sum, metric_sums, bad
+# One on-device accumulator fold: O(1) tiny-op dispatch per step, zero
+# host work. The math lives in core.executor.accum_fold — the SAME pure
+# function the windowed executor folds inside its lax.scan carry, so the
+# per-step and scan-window cadences cannot drift numerically.
+_accum_update = partial(jax.jit, static_argnames="skip_nonfinite")(accum_fold)
 
 
 class _PassStats:
@@ -246,6 +239,14 @@ class _PassStats:
             cs += c
             ms = [m + float(np.asarray(v)) for m, v in zip(ms, metrics)]
         self.host = (n, cs, ms, bad + (0 if finite else 1))
+
+    def absorb_window(self, new_state, k: int) -> None:
+        """Scan-window path: the executor folded k steps into the
+        accumulator INSIDE its compiled window — adopt the returned
+        carry. No dispatch, no sync; `sync` stays the only fence."""
+        assert self.device, "scan windows require the device accumulator"
+        self.state = new_state
+        self.steps += int(k)
 
     def pending(self) -> int:
         return self.steps - self.synced_steps
@@ -298,6 +299,25 @@ def _poison_feed(feed: Dict[str, Any]) -> Dict[str, Any]:
         if any(_is_float(l) for l in jax.tree_util.tree_leaves(out[k])):
             out[k] = jax.tree_util.tree_map(
                 lambda a: a * np.nan if _is_float(a) else a, out[k])
+            return out
+    return out
+
+
+def _poison_window_slot(feed: Dict[str, Any], i: int) -> Dict[str, Any]:
+    """Windowed counterpart of _poison_feed: NaN-poison step i of the
+    stacked window in the first float feed slot (fault injection must hit
+    exactly one step so the guard's ≤1-window detection bound is what the
+    chaos test actually measures)."""
+    def _is_float(a):
+        return hasattr(a, "dtype") and np.issubdtype(
+            np.dtype(a.dtype), np.floating)
+
+    out = dict(feed)
+    for k in sorted(out):
+        if any(_is_float(l) for l in jax.tree_util.tree_leaves(out[k])):
+            out[k] = jax.tree_util.tree_map(
+                lambda a: a.at[i].set(a[i] * np.nan) if _is_float(a) else a,
+                out[k])
             return out
     return out
 
@@ -422,6 +442,21 @@ class Trainer:
         # this — bench.py's train_loop microbench asserts the async loop
         # fences strictly less often than the sync loop
         self.host_sync_count = 0
+        # host-dispatch accounting: every Executor.run / run_window the
+        # step loop issues. The scan-window acceptance test is counted in
+        # THIS unit: K fused steps = 1 dispatch (bench train_loop asserts
+        # scan <= async dispatches; PERF.md 'Breaking the dispatch floor')
+        self.host_dispatch_count = 0
+
+    # uniform counter surface: bench, the A/B tests, and the serving
+    # layer's /stats read dispatch/sync totals under the same names
+    @property
+    def dispatches_total(self) -> int:
+        return self.host_dispatch_count
+
+    @property
+    def syncs_total(self) -> int:
+        return self.host_sync_count
 
     # -- lifecycle ---------------------------------------------------------
     def init(self) -> "Trainer":
@@ -468,6 +503,14 @@ class Trainer:
             return 1
         return max(1, int(FLAGS.log_period))
 
+    def _resolve_scan_window(self, scan_window: Optional[int]) -> int:
+        """Window size K of the fused (lax.scan) step loop. Explicit
+        `scan_window` wins, then FLAGS.scan_window (PT_FLAGS_SCAN_WINDOW /
+        CLI --scan_window). 0 = the per-step loop. Resolution only — the
+        executor-capability and param-stats gates live in _train."""
+        k = scan_window if scan_window is not None else FLAGS.scan_window
+        return max(0, int(k))
+
     # -- training ----------------------------------------------------------
     def train(
         self,
@@ -479,6 +522,7 @@ class Trainer:
         test_reader: Optional[Callable] = None,
         prefetch_to_device: Optional[int] = None,
         log_interval: Optional[int] = None,
+        scan_window: Optional[int] = None,
     ) -> Dict[str, float]:
         """Pass/batch loop. Returns the final EndPass metrics dict.
 
@@ -492,6 +536,17 @@ class Trainer:
         on device and are read back every `log_interval` steps (and at
         pass end). Default (None) resolves via FLAGS.sync_every /
         log_period; 1 is the fully synchronous legacy loop.
+
+        scan_window=K fuses K steps into ONE compiled program (a
+        lax.scan over a device-resident window of K stacked batches):
+        one host dispatch per window, metric accumulator and non-finite
+        counter inside the scan carry, host syncs only at window edges
+        on the log_interval/sync_every cadence. Default (None) resolves
+        via FLAGS.scan_window; 0 disables. Fixed-seed runs produce
+        bit-identical parameters to the per-step loop; checkpoint
+        cadence and StepGuard detection quantize to window boundaries,
+        and events/stop() are delivered per window (a stop or SIGTERM
+        finishes the in-flight window first).
 
         Preemption: while training runs (main thread only), SIGTERM and
         SIGINT are translated into finish-the-current-batch → emergency
@@ -519,7 +574,8 @@ class Trainer:
         try:
             return self._train(reader, num_passes, feed_order,
                                event_handler, fetch_metrics, test_reader,
-                               prefetch_to_device, log_interval)
+                               prefetch_to_device, log_interval,
+                               scan_window)
         finally:
             for s, h in installed.items():
                 signal.signal(s, h)
@@ -542,6 +598,7 @@ class Trainer:
         test_reader: Optional[Callable] = None,
         prefetch_to_device: Optional[int] = None,
         log_interval: Optional[int] = None,
+        scan_window: Optional[int] = None,
     ) -> Dict[str, float]:
         handler = event_handler or (lambda e: None)
         feeder = DataFeeder(feed_order) if feed_order is not None else None
@@ -556,6 +613,26 @@ class Trainer:
                 FLAGS.prefetch_to_device
                 if getattr(self.exe, "prefetch_by_default", True) else 0)
         sync_every = self._resolve_sync_every(log_interval)
+        scan_k = self._resolve_scan_window(scan_window)
+        if scan_k and not (
+                getattr(self.exe, "scan_window_supported", False)
+                and device_acc):
+            # mesh executors own input placement and their committed
+            # fetches can't ride a single-device scan carry — the window
+            # path is explicitly disabled there until it is threaded
+            # through the mesh (loud, not silent: perf knobs that no-op
+            # quietly cost days of confusion)
+            logging.getLogger("paddle_tpu.trainer").warning(
+                "scan_window=%d requested but %s does not support fused "
+                "step windows — falling back to the per-step loop",
+                scan_k, type(self.exe).__name__)
+            scan_k = 0
+        if scan_k and FLAGS.show_param_stats_period:
+            logging.getLogger("paddle_tpu.trainer").warning(
+                "scan_window disabled: show_param_stats_period needs "
+                "per-step gradient fetches the fused window does not "
+                "surface")
+            scan_k = 0
 
         for pass_id in range(self.start_pass, num_passes):
             handler(BeginPass(pass_id))
@@ -564,130 +641,16 @@ class Trainer:
                              device=device_acc, on_sync=self._count_sync)
             skip_until = self._resume_batch
             self._resume_batch = 0  # only the resumed pass skips
-            last_batch_id = -1
-            interrupted_mid_pass = False
-            if prefetch_to_device:
-                from .data.feeder import DevicePrefetcher
-
-                batches = iter(
-                    DevicePrefetcher(reader, feeder, depth=prefetch_to_device)
-                )
+            if scan_k:
+                last_batch_id, interrupted_mid_pass = self._scan_pass(
+                    pass_id, reader, feeder, scan_k, acc, fetch_list,
+                    metric_names, handler, guard, sync_every, skip_until,
+                    prefetch_to_device)
             else:
-                batches = reader()
-            for batch_id, data in enumerate(batches):
-                if self._stop:
-                    interrupted_mid_pass = True
-                    break
-                last_batch_id = batch_id
-                if batch_id < skip_until:
-                    continue
-                handler(BeginIteration(pass_id, batch_id))
-                with profiler.timer("prepareBatchData"):
-                    if prefetch_to_device:
-                        feed = data  # already converted + on device
-                    else:
-                        feed = feeder.feed(data) if feeder else data
-                sp = FLAGS.show_param_stats_period
-                want_stats = bool(sp) and (self.step + 1) % sp == 0
-                step_fetch = list(fetch_list)
-                stat_params = []
-                if want_stats:
-                    # grad vars are jit temporaries, not scope residents —
-                    # fetch them explicitly on stats steps. Only params the
-                    # autodiff op actually differentiates have grad vars
-                    # (frozen/unconnected params do not).
-                    trained = set()
-                    for block in self.main_program.blocks:
-                        for op in block.ops:
-                            if op.type == "autodiff":
-                                trained |= set(op.attrs.get("params", ()))
-                    stat_params = [
-                        p.name
-                        for p in self.main_program.parameters()
-                        if p.name in trained
-                    ]
-                    step_fetch += [grad_var_name(p) for p in stat_params]
-                if faults.fire("executor.step", step=self.step) == "corrupt":
-                    feed = _poison_feed(feed)
-                # enqueue only: fetches stay on device, the timer measures
-                # dispatch cost; device wait shows up under hostSync
-                with profiler.timer("forwardBackward"):
-                    outs = self.exe.run(
-                        self.main_program,
-                        feed=feed,
-                        fetch_list=step_fetch,
-                        scope=self.scope,
-                        as_numpy=False,
-                    )
-                cost_dev = outs[0]
-                grads = None
-                if want_stats:
-                    # reference: TrainerInternal.cpp:81-109 param stats dump
-                    grads = dict(zip(stat_params, outs[len(fetch_list):]))
-                    outs = outs[: len(fetch_list)]
-                    for pname, st in profiler.parameter_stats(
-                        self.main_program, self.scope, grads=grads
-                    ).items():
-                        print(f"  param {pname}: " + ", ".join(
-                            f"{k}={v:.4g}" for k, v in st.items()))
-                metric_devs = outs[1:]
-                acc.update(cost_dev, metric_devs)
-                # per-step sync: legacy cadence, a hot StepGuard (open
-                # streak / cool-down), or a stats step (it prints anyway)
-                per_step = (sync_every == 1 or want_stats
-                            or (guard is not None and guard.in_cooldown()))
-                if per_step:
-                    with profiler.timer("hostSync"):
-                        cost, metric_vals = self._host_read_step(
-                            cost_dev, metric_devs)
-                    if guard is not None:
-                        ok = guard.observe(cost, grads, scope=self.scope)
-                        acc.note_observed(not np.isfinite(cost))
-                        if not ok:
-                            # non-finite step: it is consumed (step counter,
-                            # events) but contributes nothing to the pass
-                            # stats (the accumulator gated it out) and NEVER
-                            # triggers the checkpoint cadence — poisoned
-                            # params must not become the "last good
-                            # checkpoint" a rollback would then restore
-                            self.step += 1
-                            handler(EndIteration(
-                                pass_id, batch_id, self.step, cost, {}))
-                            if guard.wants_rollback():
-                                self._rollback(guard)
-                            continue
-                    batch_metrics = dict(zip(metric_names, metric_vals))
-                    self.step += 1
-                    handler(EndIteration(
-                        pass_id, batch_id, self.step, cost, batch_metrics))
-                else:
-                    self.step += 1
-                    lazy_cost = _LazyScalar(cost_dev, self._count_sync)
-                    handler(EndIteration(
-                        pass_id, batch_id, self.step, lazy_cost,
-                        {k: _LazyScalar(v, self._count_sync)
-                         for k, v in zip(metric_names, metric_devs)}))
-                    if acc.pending() >= sync_every:
-                        with profiler.timer("hostSync"):
-                            n_good, n_bad = acc.sync()
-                        if guard is not None and not guard.observe_window(
-                                n_good, n_bad, scope=self.scope):
-                            if guard.wants_rollback():
-                                self._rollback(guard)
-                            continue  # dirty window: no checkpoint either
-                cc = self.checkpoint_config
-                if cc and cc.step_interval and self.step % cc.step_interval == 0:
-                    if guard is not None and acc.pending():
-                        # the cadence landed between syncs: learn the
-                        # window's outcome before persisting anything
-                        with profiler.timer("hostSync"):
-                            n_good, n_bad = acc.sync()
-                        if not guard.observe_window(
-                                n_good, n_bad, scope=self.scope):
-                            if guard.wants_rollback():
-                                self._rollback(guard)
-                            continue
-                    self._save_checkpoint(pass_id, batch_id=batch_id)
+                last_batch_id, interrupted_mid_pass = self._step_pass(
+                    pass_id, reader, feeder, acc, fetch_list, metric_names,
+                    handler, guard, sync_every, skip_until,
+                    prefetch_to_device)
             # pass end: materialize whatever the cadence hasn't yet
             if acc.pending() or acc.device:
                 with profiler.timer("hostSync"):
@@ -732,6 +695,294 @@ class Trainer:
             raise PreemptedError(
                 signame, checkpointed=self.checkpoint_config is not None)
         return last_metrics
+
+    def _step_pass(
+        self,
+        pass_id: int,
+        reader: Callable,
+        feeder: Optional[DataFeeder],
+        acc: "_PassStats",
+        fetch_list,
+        metric_names,
+        handler: Callable,
+        guard: Optional[StepGuard],
+        sync_every: int,
+        skip_until: int,
+        prefetch_to_device: int,
+    ):
+        """One pass of the per-step (PR 5 pipelined) loop. Returns
+        (last_batch_id, interrupted_mid_pass) for the shared pass-end
+        logic in _train."""
+        last_batch_id = -1
+        interrupted_mid_pass = False
+        if prefetch_to_device:
+            from .data.feeder import DevicePrefetcher
+
+            batches = iter(
+                DevicePrefetcher(reader, feeder, depth=prefetch_to_device)
+            )
+        else:
+            batches = reader()
+        for batch_id, data in enumerate(batches):
+            if self._stop:
+                interrupted_mid_pass = True
+                break
+            last_batch_id = batch_id
+            if batch_id < skip_until:
+                continue
+            handler(BeginIteration(pass_id, batch_id))
+            with profiler.timer("prepareBatchData"):
+                if prefetch_to_device:
+                    feed = data  # already converted + on device
+                else:
+                    feed = feeder.feed(data) if feeder else data
+            sp = FLAGS.show_param_stats_period
+            want_stats = bool(sp) and (self.step + 1) % sp == 0
+            step_fetch = list(fetch_list)
+            stat_params = []
+            if want_stats:
+                # grad vars are jit temporaries, not scope residents —
+                # fetch them explicitly on stats steps. Only params the
+                # autodiff op actually differentiates have grad vars
+                # (frozen/unconnected params do not).
+                trained = set()
+                for block in self.main_program.blocks:
+                    for op in block.ops:
+                        if op.type == "autodiff":
+                            trained |= set(op.attrs.get("params", ()))
+                stat_params = [
+                    p.name
+                    for p in self.main_program.parameters()
+                    if p.name in trained
+                ]
+                step_fetch += [grad_var_name(p) for p in stat_params]
+            if faults.fire("executor.step", step=self.step) == "corrupt":
+                feed = _poison_feed(feed)
+            # enqueue only: fetches stay on device, the timer measures
+            # dispatch cost; device wait shows up under hostSync
+            with profiler.timer("forwardBackward"):
+                outs = self.exe.run(
+                    self.main_program,
+                    feed=feed,
+                    fetch_list=step_fetch,
+                    scope=self.scope,
+                    as_numpy=False,
+                )
+            self.host_dispatch_count += 1
+            cost_dev = outs[0]
+            grads = None
+            if want_stats:
+                # reference: TrainerInternal.cpp:81-109 param stats dump
+                grads = dict(zip(stat_params, outs[len(fetch_list):]))
+                outs = outs[: len(fetch_list)]
+                for pname, st in profiler.parameter_stats(
+                    self.main_program, self.scope, grads=grads
+                ).items():
+                    print(f"  param {pname}: " + ", ".join(
+                        f"{k}={v:.4g}" for k, v in st.items()))
+            metric_devs = outs[1:]
+            acc.update(cost_dev, metric_devs)
+            # per-step sync: legacy cadence, a hot StepGuard (open
+            # streak / cool-down), or a stats step (it prints anyway)
+            per_step = (sync_every == 1 or want_stats
+                        or (guard is not None and guard.in_cooldown()))
+            if per_step:
+                with profiler.timer("hostSync"):
+                    cost, metric_vals = self._host_read_step(
+                        cost_dev, metric_devs)
+                if guard is not None:
+                    ok = guard.observe(cost, grads, scope=self.scope)
+                    acc.note_observed(not np.isfinite(cost))
+                    if not ok:
+                        # non-finite step: it is consumed (step counter,
+                        # events) but contributes nothing to the pass
+                        # stats (the accumulator gated it out) and NEVER
+                        # triggers the checkpoint cadence — poisoned
+                        # params must not become the "last good
+                        # checkpoint" a rollback would then restore
+                        self.step += 1
+                        handler(EndIteration(
+                            pass_id, batch_id, self.step, cost, {}))
+                        if guard.wants_rollback():
+                            self._rollback(guard)
+                        continue
+                batch_metrics = dict(zip(metric_names, metric_vals))
+                self.step += 1
+                handler(EndIteration(
+                    pass_id, batch_id, self.step, cost, batch_metrics))
+            else:
+                self.step += 1
+                lazy_cost = _LazyScalar(cost_dev, self._count_sync)
+                handler(EndIteration(
+                    pass_id, batch_id, self.step, lazy_cost,
+                    {k: _LazyScalar(v, self._count_sync)
+                     for k, v in zip(metric_names, metric_devs)}))
+                if acc.pending() >= sync_every:
+                    with profiler.timer("hostSync"):
+                        n_good, n_bad = acc.sync()
+                    if guard is not None and not guard.observe_window(
+                            n_good, n_bad, scope=self.scope):
+                        if guard.wants_rollback():
+                            self._rollback(guard)
+                        continue  # dirty window: no checkpoint either
+            cc = self.checkpoint_config
+            if cc and cc.step_interval and self.step % cc.step_interval == 0:
+                if guard is not None and acc.pending():
+                    # the cadence landed between syncs: learn the
+                    # window's outcome before persisting anything
+                    with profiler.timer("hostSync"):
+                        n_good, n_bad = acc.sync()
+                    if not guard.observe_window(
+                            n_good, n_bad, scope=self.scope):
+                        if guard.wants_rollback():
+                            self._rollback(guard)
+                        continue
+                self._save_checkpoint(pass_id, batch_id=batch_id)
+        return last_batch_id, interrupted_mid_pass
+
+    def _scan_pass(
+        self,
+        pass_id: int,
+        reader: Callable,
+        feeder: Optional[DataFeeder],
+        scan_k: int,
+        acc: "_PassStats",
+        fetch_list,
+        metric_names,
+        handler: Callable,
+        guard: Optional[StepGuard],
+        sync_every: int,
+        skip_until: int,
+        prefetch_to_device: int,
+    ):
+        """One pass of the windowed (ISSUE 6) loop: the DevicePrefetcher
+        stacks K committed batches to a leading window axis and the
+        executor scans the train step over them in ONE dispatch. The
+        accumulator state IS the scan carry, so cost/metrics/non-finite
+        counts cross the host boundary only at window-edge syncs on the
+        sync_every cadence. Checkpoint cadence quantizes to window
+        boundaries; a hot StepGuard (open streak / cool-down) degrades to
+        windows of 1 so recovery keeps step-granular semantics. stop()
+        and SIGTERM finish the in-flight window, then the shared pass-end
+        logic checkpoints at the window boundary."""
+        from .data.feeder import DevicePrefetcher
+
+        src = reader
+        if skip_until:
+            # resume mid-pass: deterministic readers replay — drop the
+            # already-trained batches BEFORE windowing so windows align
+            # to the resume point instead of straddling it
+            def src():
+                for i, b in enumerate(reader()):
+                    if i >= skip_until:
+                        yield b
+        # depth counts windows here; ceil so the buffered batch count is
+        # always >= the configured prefetch depth AND >= one full window
+        depth = max(1, -(-max(1, prefetch_to_device) // scan_k)) + 1
+        windows = iter(DevicePrefetcher(
+            src, feeder, depth=depth, window=scan_k))
+        next_batch = skip_until
+        last_batch_id = skip_until - 1
+        interrupted_mid_pass = False
+        for win in windows:
+            if self._stop:
+                interrupted_mid_pass = True
+                break
+            k = win.k
+            bids = list(range(next_batch, next_batch + k))
+            next_batch += k
+            for b in bids:
+                handler(BeginIteration(pass_id, b))
+            feed = win.feed
+            for i in range(k):
+                if faults.fire("executor.step",
+                               step=self.step + i) == "corrupt":
+                    feed = _poison_window_slot(feed, i)
+            dirty = False
+            if guard is not None and guard.in_cooldown():
+                # step-granular recovery: run this window's steps as K
+                # windows of 1, syncing and observing the guard each step
+                for i in range(k):
+                    if not self._scan_one(pass_id, bids[i], win.slice(i),
+                                          acc, fetch_list, metric_names,
+                                          handler, guard):
+                        dirty = True
+                last_batch_id = bids[-1]
+            else:
+                with profiler.timer("forwardBackward"):
+                    ys, acc_out = self.exe.run_window(
+                        self.main_program,
+                        feed=feed,
+                        fetch_list=fetch_list,
+                        scope=self.scope,
+                        acc_state=acc.state,
+                        skip_nonfinite=acc.skip_nonfinite,
+                    )
+                self.host_dispatch_count += 1
+                acc.absorb_window(acc_out, k)
+                for i in range(k):
+                    self.step += 1
+                    handler(EndIteration(
+                        pass_id, bids[i], self.step,
+                        _LazyScalar(ys[0], self._count_sync, index=i),
+                        {m: _LazyScalar(v, self._count_sync, index=i)
+                         for m, v in zip(metric_names, ys[1:])}))
+                last_batch_id = bids[-1]
+                if acc.pending() >= sync_every:
+                    with profiler.timer("hostSync"):
+                        n_good, n_bad = acc.sync()
+                    if guard is not None and not guard.observe_window(
+                            n_good, n_bad, scope=self.scope):
+                        dirty = True  # rollback discards the whole window
+                        if guard.wants_rollback():
+                            self._rollback(guard)
+            cc = self.checkpoint_config
+            if dirty or not (cc and cc.step_interval):
+                continue
+            # cadence quantized to window boundaries: save once if ANY
+            # step inside this window crossed a step_interval multiple
+            if (self.step // cc.step_interval) > (
+                    (self.step - k) // cc.step_interval):
+                if guard is not None and acc.pending():
+                    with profiler.timer("hostSync"):
+                        n_good, n_bad = acc.sync()
+                    if not guard.observe_window(
+                            n_good, n_bad, scope=self.scope):
+                        if guard.wants_rollback():
+                            self._rollback(guard)
+                        continue  # dirty window: no checkpoint either
+                self._save_checkpoint(pass_id, batch_id=last_batch_id)
+        return last_batch_id, interrupted_mid_pass
+
+    def _scan_one(self, pass_id, batch_id, feed, acc, fetch_list,
+                  metric_names, handler, guard: StepGuard) -> bool:
+        """Guard-hot fallback: one step as a window of 1 — same compiled
+        shape family as the scan path, but the accumulator syncs and the
+        guard observes after every step, exactly the per-step-sync
+        semantics recovery requires. Returns True iff the step was
+        clean (a dirty step suppresses the window's checkpoint cadence,
+        matching the per-step loop)."""
+        with profiler.timer("forwardBackward"):
+            ys, acc_out = self.exe.run_window(
+                self.main_program, feed=feed, fetch_list=fetch_list,
+                scope=self.scope, acc_state=acc.state,
+                skip_nonfinite=acc.skip_nonfinite)
+        self.host_dispatch_count += 1
+        acc.absorb_window(acc_out, 1)
+        self.step += 1
+        with profiler.timer("hostSync"):
+            n_good, n_bad = acc.sync()
+        handler(EndIteration(
+            pass_id, batch_id, self.step,
+            _LazyScalar(ys[0], self._count_sync, index=0),
+            {m: _LazyScalar(v, self._count_sync, index=0)
+             for m, v in zip(metric_names, ys[1:])}))
+        if guard is not None and not guard.observe_window(
+                n_good, n_bad, scope=self.scope):
+            if guard.wants_rollback():
+                self._rollback(guard)
+            return False
+        return True
 
     # -- testing (paddle/trainer/Tester.cpp; v2 trainer.test) --------------
     def test(

@@ -79,3 +79,24 @@ def sync_mode(request):
     FLAGS.sync_every = 1 if request.param == "sync" else 64
     yield request.param
     FLAGS.sync_every = saved
+
+
+@pytest.fixture(params=["step", "async", "scan"])
+def windowed(request):
+    """sync_mode extended with the ISSUE 6 scan-window mode: "step" is
+    the per-step-sync legacy loop, "async" the cadence-sync pipelined
+    loop, "scan" fuses 4 steps per compiled lax.scan window. A trainer
+    test taking this fixture runs in all three — the three loops must be
+    observably identical (same convergence, same resume positions up to
+    window quantization), which keeps the step/async/scan matrix green
+    by construction as the trainer grows."""
+    from paddle_tpu.flags import FLAGS
+
+    saved = (FLAGS.sync_every, FLAGS.scan_window)
+    FLAGS.sync_every, FLAGS.scan_window = {
+        "step": (1, 0),
+        "async": (64, 0),
+        "scan": (64, 4),
+    }[request.param]
+    yield request.param
+    FLAGS.sync_every, FLAGS.scan_window = saved

@@ -1,0 +1,365 @@
+"""Windowed training loop (ISSUE 6): K steps fused into one jitted
+lax.scan dispatch.
+
+The load-bearing claim mirrors the PR 5 pipeline's: fusing changes HOW
+MANY programs the host dispatches, never WHAT the device computes. The
+fixed-seed A/B demands bit-identical final parameters and identical pass
+metrics between the per-step loop and the scan loop — including a ragged
+final window and a StepGuard-armed run — while the dispatch counter must
+drop by ~K. Window-edge semantics (guard detection lag, checkpoint
+quantization, SIGTERM finishing the in-flight window) get their own
+cases, and the stray-host-sync lint extends to the window path's modules.
+"""
+
+import json
+import os
+import signal
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import io as pio
+from paddle_tpu.resilience import PreemptedError, faults
+from paddle_tpu.resilience.guard import StepGuard
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ------------------------------------------------------ model + data helpers
+
+def _mnist_mlp():
+    img = pt.layers.data("img", shape=[784])
+    label = pt.layers.data("label", shape=[1], dtype=np.int32)
+    h = pt.layers.fc(img, size=64, act="tanh")
+    logits = pt.layers.fc(h, size=10)
+    loss = pt.layers.mean(pt.layers.softmax_with_cross_entropy(logits, label))
+    acc = pt.layers.accuracy(logits, label)
+    return loss, acc
+
+
+def _mnist_reader(n_batches=10, batch=16, seed=0):
+    rng = np.random.RandomState(seed)
+    data = [
+        {"img": rng.randn(batch, 784).astype(np.float32),
+         "label": rng.randint(0, 10, (batch, 1)).astype(np.int32)}
+        for _ in range(n_batches)
+    ]
+
+    def reader():
+        yield from data
+    return reader
+
+
+def _train_once(log_interval, scan_window, reader, num_passes=2,
+                step_guard=None, checkpoint_dir=None, event_handler=None,
+                arm=None, step_interval=2):
+    pt.reset()
+    if arm is not None:
+        arm()  # pt.reset() disarms the fault registry — re-arm after it
+    prog, startup = pt.Program(), pt.Program()
+    startup.random_seed = 1234
+    with pt.program_guard(prog, startup):
+        loss, acc = _mnist_mlp()
+        pt.optimizer.Adam(learning_rate=1e-3).minimize(loss)
+    cc = (pt.CheckpointConfig(checkpoint_dir, epoch_interval=0,
+                              step_interval=step_interval,
+                              max_num_checkpoints=100)
+          if checkpoint_dir else None)
+    trainer = pt.Trainer(loss, main_program=prog, startup_program=startup,
+                         checkpoint_config=cc, step_guard=step_guard)
+    metrics = trainer.train(
+        reader, num_passes=num_passes, fetch_metrics={"acc": acc},
+        event_handler=event_handler, log_interval=log_interval,
+        scan_window=scan_window)
+    params = {p.name: np.asarray(pt.global_scope().get(p.name)).copy()
+              for p in prog.parameters()}
+    return metrics, params, trainer
+
+
+# ------------------------------------------------- the acceptance A/B
+
+
+@pytest.mark.parametrize("k", [1, 4])
+def test_scan_vs_step_bitidentical_params_and_metrics(k):
+    """Fixed-seed MNIST-mlp, 10 batches (K=4 ⇒ windows of 4,4,2 — the
+    ragged tail is part of the A/B): the scan loop must produce the SAME
+    run as the per-step-sync loop, and for K>1 it must issue strictly
+    fewer host dispatches — the whole point of fusing."""
+    reader = _mnist_reader()
+    m_step, p_step, t_step = _train_once(1, None, reader)
+    m_scan, p_scan, t_scan = _train_once(16, k, reader)
+
+    assert sorted(p_step) == sorted(p_scan)
+    for name in p_step:
+        np.testing.assert_array_equal(p_step[name], p_scan[name])
+    assert m_step == m_scan, (m_step, m_scan)
+    assert np.isfinite(m_scan["cost"]) and "acc" in m_scan
+    assert t_scan.host_sync_count < t_step.host_sync_count
+    assert t_scan.host_dispatch_count <= t_step.host_dispatch_count
+    if k > 1:
+        # 10 batches/pass, 2 passes: 20 per-step dispatches vs 6 windows
+        assert t_scan.host_dispatch_count < t_step.host_dispatch_count, (
+            t_scan.host_dispatch_count, t_step.host_dispatch_count)
+        assert t_scan.host_dispatch_count == 2 * 3  # 4+4+2 per pass
+
+
+def test_scan_vs_async_fewer_dispatches():
+    """PR 5's async loop HIDES the per-step dispatch; the window loop
+    REMOVES it. Same cadence, same params — fewer dispatches."""
+    reader = _mnist_reader(n_batches=8)
+    m_async, p_async, t_async = _train_once(16, None, reader)
+    m_scan, p_scan, t_scan = _train_once(16, 4, reader)
+    for name in p_async:
+        np.testing.assert_array_equal(p_async[name], p_scan[name])
+    assert m_async == m_scan
+    assert t_scan.host_dispatch_count < t_async.host_dispatch_count, (
+        t_scan.host_dispatch_count, t_async.host_dispatch_count)
+    assert t_scan.host_sync_count <= t_async.host_sync_count
+
+
+def test_scan_guard_armed_ab_still_bitidentical(tmp_path):
+    """A StepGuard-armed run (skip_nonfinite accumulator variant, clean
+    data) must also be bit-identical across step/scan — the guard only
+    changes what happens on NON-finite steps."""
+    reader = _mnist_reader(n_batches=8)
+    d1, d2 = str(tmp_path / "a"), str(tmp_path / "b")
+    m_step, p_step, _ = _train_once(
+        1, None, reader, step_guard=StepGuard(), checkpoint_dir=d1)
+    m_scan, p_scan, _ = _train_once(
+        4, 4, reader, step_guard=StepGuard(), checkpoint_dir=d2)
+    for name in p_step:
+        np.testing.assert_array_equal(p_step[name], p_scan[name])
+    assert m_step == m_scan
+
+
+# ------------------------------------------------- window-edge semantics
+
+
+@pytest.mark.chaos
+def test_scan_guard_catches_nan_within_one_window(tmp_path):
+    """Poison one step inside a window: the on-device non-finite counter
+    rides the scan carry, so the guard learns of it at that window's
+    edge sync — within ≤1 window of the injection — rolls back to a
+    pre-NaN checkpoint (discarding the WHOLE window) and finishes
+    finite."""
+    d = str(tmp_path / "ck")
+    reader = _mnist_reader(n_batches=12)
+    guard = StepGuard(max_consecutive=1, cooldown_steps=2, lr_factor=0.5)
+    rolled_back_at = []
+
+    def watch(e):
+        if isinstance(e, pt.EndIteration) and guard.rollbacks:
+            rolled_back_at.append(e.step)
+
+    try:
+        m, params, trainer = _train_once(
+            4, 4, reader, num_passes=1, step_guard=guard, checkpoint_dir=d,
+            event_handler=watch, step_interval=4,
+            arm=lambda: faults.arm("executor.step", hit=6, action="corrupt"))
+    finally:
+        faults.disarm()
+    assert faults.stats()["executor.step"]["fired"] == 1
+    st = guard.stats()
+    # the poison landed at step 6 (window 5-8); the window-edge sync after
+    # step 8 must have seen it — not the pass end at step 12
+    assert st["skipped"] >= 1 and st["rollbacks"] >= 1, st
+    assert rolled_back_at and min(rolled_back_at) <= 9, rolled_back_at
+    assert np.isfinite(m["cost"]), m
+    for name, w in params.items():
+        assert np.isfinite(w).all(), name
+    # rollback discarded the WHOLE window: the counter rewound to the
+    # step-4 boundary checkpoint, so the 12 consumed batches land the
+    # final counter at 8 — the poisoned window contributed nothing
+    assert trainer.step == 8, trainer.step
+
+
+@pytest.mark.chaos
+def test_scan_guard_never_checkpoints_poison(tmp_path):
+    """Every serial on disk after a scan-mode guard run holds finite
+    parameters — the window-boundary cadence synced (and observed the
+    guard) before persisting anything."""
+    d = str(tmp_path / "ck")
+    reader = _mnist_reader(n_batches=12)
+    guard = StepGuard(max_consecutive=1, cooldown_steps=1)
+    try:
+        _train_once(4, 4, reader, num_passes=1, step_guard=guard,
+                    checkpoint_dir=d, step_interval=4,
+                    arm=lambda: faults.arm("executor.step", hit=5,
+                                           action="corrupt"))
+    finally:
+        faults.disarm()
+    latest = pio.get_latest_checkpoint_serial(d)
+    assert latest >= 0
+    for s in range(latest + 1):
+        sd = os.path.join(d, f"checkpoint_{s}")
+        if not os.path.isdir(sd):
+            continue
+        pt.reset_global_scope()
+        pio.load_vars(sd)
+        for name in pt.global_scope().keys():
+            assert np.isfinite(
+                np.asarray(pt.global_scope().get(name))).all(), (s, name)
+
+
+def test_scan_checkpoint_cadence_quantized_to_window_boundary(tmp_path):
+    """step_interval=3 with K=4: the cadence fires once per window that
+    CROSSES a multiple of 3, at the window edge — every serial's step is
+    a window boundary (multiple of 4), and the background commit holds
+    the values of that boundary step (drained, sha-verified)."""
+    d = str(tmp_path / "ck")
+    reader = _mnist_reader(n_batches=8)
+    snaps = {}
+
+    def grab(e):
+        if isinstance(e, pt.EndIteration) and e.step % 4 == 0:
+            snaps[e.step] = {
+                p.name: np.asarray(pt.global_scope().get(p.name)).copy()
+                for p in pt.default_main_program().parameters()}
+
+    _train_once(16, 4, reader, num_passes=1, checkpoint_dir=d,
+                event_handler=grab, step_interval=3)
+    serials = sorted(
+        int(n.split("_")[1]) for n in os.listdir(d)
+        if n.startswith("checkpoint_") and not n.endswith(".corrupt"))
+    assert serials, "cadence never fired"
+    steps_seen = []
+    for serial in serials:
+        sd = os.path.join(d, f"checkpoint_{serial}")
+        pio.verify_checkpoint(sd)  # background write fully drained
+        with open(os.path.join(sd, pio.META_FILE)) as f:
+            step = json.load(f)["trainer_args"]["step"]
+        steps_seen.append(step)
+        assert step % 4 == 0, f"serial {serial} at non-boundary step {step}"
+        if step in snaps:
+            pt.reset_global_scope()
+            pio.load_vars(sd)
+            for name, want in snaps[step].items():
+                np.testing.assert_array_equal(
+                    np.asarray(pt.global_scope().get(name)), want)
+    assert steps_seen == [4, 8], steps_seen  # crossings of 3 and 6
+
+
+@pytest.mark.chaos
+def test_scan_sigterm_mid_window_finishes_window_then_checkpoints(tmp_path):
+    """SIGTERM delivered while a window is being assembled/dispatched:
+    the trainer finishes the in-flight window, emergency-checkpoints at
+    its boundary, and raises PreemptedError — resume re-enters at the
+    window edge, losing zero completed steps."""
+    d = str(tmp_path / "ck")
+    reader = _mnist_reader(n_batches=12)
+
+    def kill_mid_window(e):
+        # BeginIteration for batch 5 fires during window 2's assembly —
+        # before its dispatch completes
+        if isinstance(e, pt.BeginIteration) and e.batch_id == 5:
+            os.kill(os.getpid(), signal.SIGTERM)
+
+    pt.reset()
+    prog, startup = pt.Program(), pt.Program()
+    startup.random_seed = 7
+    with pt.program_guard(prog, startup):
+        loss, acc = _mnist_mlp()
+        pt.optimizer.Adam(learning_rate=1e-3).minimize(loss)
+    cc = pt.CheckpointConfig(d, epoch_interval=0, step_interval=0)
+    trainer = pt.Trainer(loss, main_program=prog, startup_program=startup,
+                         checkpoint_config=cc)
+    with pytest.raises(PreemptedError):
+        trainer.train(reader, num_passes=2, event_handler=kill_mid_window,
+                      log_interval=16, scan_window=4)
+    # the emergency save landed at the boundary of the window that was
+    # in flight when the signal arrived (batches 4-7 → step 8)
+    serial = pio.get_latest_checkpoint_serial(d)
+    assert serial >= 0
+    sd = os.path.join(d, f"checkpoint_{serial}")
+    pio.verify_checkpoint(sd)  # writer drained before PreemptedError
+    with open(os.path.join(sd, pio.META_FILE)) as f:
+        args = json.load(f)["trainer_args"]
+    assert args["step"] == 8 and args["mid_pass"] and args["batch_id"] == 7
+    pt.reset_global_scope()
+    t2 = pt.Trainer(loss, main_program=prog, startup_program=startup,
+                    checkpoint_config=cc)
+    t2.init()
+    assert t2.step == 8 and t2._resume_batch == 8
+
+
+# ------------------------------------------------- window assembly
+
+
+def test_prefetcher_window_grouping_and_ragged_flush():
+    """DevicePrefetcher(window=4): consecutive same-signature batches
+    stack to FeedWindow objects; a signature change flushes the partial
+    window so no compiled window ever mixes shapes; the tail flushes at
+    pass end."""
+    from paddle_tpu.data.feeder import DevicePrefetcher, FeedWindow
+
+    def reader():
+        for _ in range(5):
+            yield {"x": np.ones((2, 3), np.float32)}
+        for _ in range(3):
+            yield {"x": np.ones((4, 3), np.float32)}  # signature change
+
+    wins = list(DevicePrefetcher(reader, window=4))
+    assert all(isinstance(w, FeedWindow) for w in wins)
+    assert [w.k for w in wins] == [4, 1, 3]
+    assert wins[0].feed["x"].shape == (4, 2, 3)
+    assert wins[2].feed["x"].shape == (3, 4, 3)
+    # slice() keeps the leading window axis (a window of 1)
+    assert wins[0].slice(2)["x"].shape == (1, 2, 3)
+
+
+def test_run_window_rejects_empty_feed():
+    import paddle_tpu.core.executor as ex
+
+    with pytest.raises(ValueError, match="feed"):
+        ex.Executor().run_window(pt.Program(), feed={}, fetch_list=[])
+
+
+def test_parallel_executor_falls_back_loudly(caplog):
+    """scan_window on a mesh executor must fall back to the per-step
+    loop with a warning, not silently no-op or crash."""
+    import logging
+
+    from paddle_tpu.parallel.data_parallel import ParallelExecutor
+
+    assert ParallelExecutor.scan_window_supported is False
+    pt.reset()
+    x = pt.layers.data("x", shape=[4])
+    y = pt.layers.data("y", shape=[1])
+    pred = pt.layers.fc(x, size=1)
+    loss = pt.layers.mean(pt.layers.square_error_cost(pred, y))
+    pt.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    trainer = pt.Trainer(loss, executor=ParallelExecutor())
+    rng = np.random.RandomState(0)
+
+    def reader():
+        for _ in range(2):
+            yield {"x": rng.randn(8, 4).astype(np.float32),
+                   "y": rng.randn(8, 1).astype(np.float32)}
+
+    with caplog.at_level(logging.WARNING, logger="paddle_tpu.trainer"):
+        m = trainer.train(reader, num_passes=1, scan_window=4)
+    assert np.isfinite(m["cost"])
+    assert any("scan_window" in r.message for r in caplog.records)
+
+
+# ------------------------------------------------- lint: no stray syncs
+
+
+def test_no_stray_host_syncs_in_window_modules():
+    """The window path (executor run_window/_build_window, feeder
+    stacking) must never read a value back to host: a single stray
+    float(np.asarray(...)) / jax.device_get would re-fence every window.
+    trainer.py's own lint (test_async_trainer) covers the trainer side;
+    this extends the ban to the modules the window path grew into."""
+    import paddle_tpu.core.executor as ex_mod
+    import paddle_tpu.data.feeder as fd_mod
+
+    for mod, allowed in ((ex_mod, ("device_get",)), (fd_mod, ())):
+        with open(mod.__file__) as f:
+            src = f.read()
+        for i, line in enumerate(src.splitlines(), 1):
+            code = line.split("#", 1)[0]
+            assert "float(np.asarray" not in code, (mod.__name__, i, line)
+            if "device_get" not in allowed:
+                assert "jax.device_get" not in code, (mod.__name__, i, line)

@@ -125,6 +125,27 @@ def test_warmup_precompiles_every_bucket(dense_model_dir):
     assert eng.exe.cache_stats["misses"] == before
 
 
+def test_uniform_dispatch_sync_counters(dense_model_dir):
+    """The engine exposes Trainer-parity dispatch/sync counters
+    (dispatches_total / syncs_total, ISSUE 6): warmup's pre-compiles
+    count as dispatches, every predict is one dispatch + one d2h fence,
+    and /stats and the Prometheus render carry the same numbers the
+    trainer A/B tests assert on."""
+    eng = ServingEngine(dense_model_dir,
+                        policy=BucketPolicy(max_batch_size=8),
+                        model_name="counters")
+    warm = eng.warmup()
+    assert eng.dispatches_total == eng.syncs_total == warm
+    rng = np.random.RandomState(3)
+    for k in (1, 3, 8):
+        eng.predict({"x": rng.randn(k, 4).astype(np.float32)})
+    s = eng.stats()
+    assert s["dispatches_total"] == warm + 3
+    assert s["syncs_total"] == warm + 3
+    rendered = eng.metrics.render()
+    assert "dispatches_total" in rendered and "syncs_total" in rendered
+
+
 def test_seq_len_buckets(seq_model_dir):
     """Varying [B, T] traffic lands on the (batch × seq) bucket grid;
     padded positions are sliced away and real positions bit-match the
