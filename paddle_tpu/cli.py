@@ -38,8 +38,12 @@ Commands:
   serve       --model_dir D [--model name=dir ...] [--host H] [--port P]
               [--max_batch_size N] [--max_wait_ms M] [--max_queue Q]
               [--timeout_ms T] [--seq_len_buckets 64,128,...] [--warmup 0|1]
+              [--max_slots S] [--gen_queue Q] [--gen_timeout_ms T]
               batching HTTP inference server over saved inference
               models (paddle_tpu.serving): /predict, /healthz, /metrics
+              — generation models additionally serve /generate
+              (continuous batching over S decode slots, NDJSON
+              streaming with "stream": true)
   tune        --kernel K --shape k=v,k=v [--shape ...] [--dtype bf16|f32]
               [--dry-run] [--cache PATH] [--iters N] [--warmup N]
               | --config M.py [--dry-run ...]
@@ -218,6 +222,20 @@ def _parse_kv(argv, known):
     return opts
 
 
+def _model_is_generative(model_dir: str) -> bool:
+    """Cheap pre-load check: does the artifact's meta.json carry the
+    generation sidecar (io.save_inference_model on a beam-search
+    model)? Decides whether serve passes continuous-batching knobs."""
+    import json as _json
+    import os as _os
+
+    try:
+        with open(_os.path.join(model_dir, "meta.json")) as f:
+            return bool(_json.load(f).get("generation"))
+    except (OSError, ValueError):
+        return False
+
+
 def _cmd_serve(argv) -> int:
     """Batching inference server over saved inference models."""
     from .serving import BucketPolicy, ModelRegistry, make_server
@@ -226,6 +244,7 @@ def _cmd_serve(argv) -> int:
         "model_dir": str, "model": list, "host": str, "port": str,
         "max_batch_size": str, "max_wait_ms": str, "max_queue": str,
         "timeout_ms": str, "seq_len_buckets": str, "warmup": str,
+        "max_slots": str, "gen_queue": str, "gen_timeout_ms": str,
     }
     opts = _parse_kv(argv, known)
     models = {}
@@ -246,6 +265,13 @@ def _cmd_serve(argv) -> int:
             int(t) for t in opts.get("seq_len_buckets", "").split(",")
             if t.strip()),
     )
+    # continuous-batching knobs for generation models (ignored — and
+    # rejected by the registry — for feed-forward ones)
+    scheduler_kw = {
+        "max_slots": int(opts.get("max_slots", 8)),
+        "max_queue": int(opts.get("gen_queue", 64)),
+        "timeout_ms": float(opts.get("gen_timeout_ms", 30000.0)),
+    }
     registry = ModelRegistry()
     for name, d in models.items():
         engine, _ = registry.add(
@@ -253,11 +279,19 @@ def _cmd_serve(argv) -> int:
             max_wait_ms=float(opts.get("max_wait_ms", 5.0)),
             max_queue=int(opts.get("max_queue", 256)),
             timeout_ms=float(opts.get("timeout_ms", 2000.0)),
+            scheduler_kw=(scheduler_kw
+                          if _model_is_generative(d) else None),
         )
         if opts.get("warmup", "1") not in ("0", "false", "no"):
             n = engine.warmup()
             print(f"model {name!r}: warmed {n} bucket programs",
                   flush=True)
+        if engine.generation_spec() is not None:
+            spec = engine.generation_spec()
+            print(f"model {name!r}: generation serving on /generate/"
+                  f"{name} (beam_size={spec.beam_size} "
+                  f"max_len={spec.max_len} "
+                  f"slots={scheduler_kw['max_slots']})", flush=True)
     server = make_server(registry, host=opts.get("host", "127.0.0.1"),
                          port=int(opts.get("port", 8866)))
     registry.start()

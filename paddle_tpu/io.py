@@ -212,12 +212,38 @@ def _prune_for_inference(
     pass and flips is_test; the walk here only slices the forward graph."""
     pruned = program.clone(for_test=True)
     block = pruned.global_block()
+
+    def sub_block_refs(op) -> set:
+        """Names an op's sub-block(s) read from the enclosing scope —
+        a beam_search_group / control-flow step body consumes
+        parameters and closures by name without listing them as op
+        inputs, so the dataflow slice must treat them as consumed or
+        their producing ops (and the params themselves) get pruned."""
+        refs: set = set()
+        idx = op.attrs.get("sub_block")
+        if not isinstance(idx, int):
+            return refs
+        stack = [idx]
+        while stack:
+            b = pruned.blocks[stack.pop()]
+            produced: set = set()
+            for sop in b.ops:
+                refs.update(n for n in sop.input_names()
+                            if n not in produced)
+                produced.update(sop.output_names())
+                inner = sop.attrs.get("sub_block")
+                if isinstance(inner, int):
+                    stack.append(inner)
+        return refs
+
     needed = set(target_names)
     kept = []
     for op in reversed(block.ops):
         if any(o in needed for o in op.output_names()):
             kept.append(op)
             needed.update(op.input_names())
+            needed.update(n for n in sub_block_refs(op)
+                          if n in block.vars)
     kept.reverse()
     block.ops = kept
 
@@ -225,6 +251,8 @@ def _prune_for_inference(
     for op in kept:
         referenced.update(op.input_names())
         referenced.update(op.output_names())
+        referenced.update(n for n in sub_block_refs(op)
+                          if n in block.vars)
     block.vars = {n: v for n, v in block.vars.items() if n in referenced}
     # every declared feed must actually be consumed by the slice
     missing = [n for n in feed_names if n not in needed]
@@ -280,6 +308,11 @@ def save_inference_model(
         "device_kind": _tune_cache.device_kind(),
         "table_fingerprint": _tune_overrides.table().fingerprint(),
     }
+    # generation-state specs travel with the artifact: beam geometry +
+    # decode-state dtypes/shapes, so the serving scheduler can allocate
+    # its device-resident slot pool (and pre-compile the pool step at
+    # warmup) without re-tracing the model source
+    generation = _generation_meta(pruned)
     with open(os.path.join(dirname, PROGRAM_FILE), "w") as f:
         json.dump(pruned.to_dict(), f)
     with open(os.path.join(dirname, META_FILE), "w") as f:
@@ -290,9 +323,46 @@ def save_inference_model(
                 "param_names": param_names,
                 "feed_specs": feed_specs,
                 "tuning": tuning,
+                **({"generation": generation} if generation else {}),
             },
             f,
         )
+
+
+def _generation_meta(pruned: Program) -> Optional[dict]:
+    """meta.json sidecar for generation models: the beam_search_group
+    geometry plus per-state trailing shapes/dtypes (batch axis
+    dropped — that's the slot axis at serving time)."""
+    block = pruned.global_block()
+    op = next((o for o in block.ops if o.type == "beam_search_group"),
+              None)
+    if op is None:
+        return None
+
+    def vspec(name):
+        try:
+            v = block.var(name)
+        except KeyError:
+            return {"name": name, "dtype": "float32", "shape": None}
+        trailing = [int(d) for d in v.shape[1:]]
+        return {"name": name, "dtype": np.dtype(v.dtype).name,
+                "shape": trailing if all(d > 0 for d in trailing)
+                else None}
+
+    return {
+        "beam_size": int(op.attrs.get("beam_size", 4)),
+        "max_len": int(op.attrs.get("max_len", 32)),
+        "bos_id": int(op.attrs.get("bos_id", 0)),
+        "eos_id": int(op.attrs.get("eos_id", 1)),
+        "length_normalize": bool(op.attrs.get("length_normalize", False)),
+        "state": [vspec(n) for n in op.inputs.get("Boot", [])],
+        "per_example": [vspec(n) for n in op.inputs.get("PerExample", [])],
+        "outputs": {
+            "ids": op.outputs["Ids"][0],
+            "scores": op.outputs["Scores"][0],
+            "lengths": op.outputs["Lengths"][0],
+        },
+    }
 
 
 def load_inference_model(dirname: str, scope: Optional[Scope] = None):
@@ -311,6 +381,10 @@ def load_inference_model(dirname: str, scope: Optional[Scope] = None):
     # exporter's device_kind + tuned-table fingerprint, checked by
     # serving.ServingEngine.warmup against the serving host's table
     program._tuning_meta = meta.get("tuning") or None
+    # generation sidecar (absent for feed-forward models / pre-gen
+    # artifacts): beam geometry + decode-state specs, consumed by
+    # serving.scheduler.ContinuousScheduler warmup
+    program._generation_meta = meta.get("generation") or None
     return program, meta["feed_names"], meta["fetch_names"]
 
 

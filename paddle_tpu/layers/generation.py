@@ -24,7 +24,22 @@ import numpy as np
 from ..core.program import Variable, unique_name
 from .helper import LayerHelper
 
-__all__ = ["BeamSearchDecoder"]
+__all__ = ["BeamSearchDecoder", "GenSpec", "DecodeState", "beam_step",
+           "find_generation_op", "gen_spec_from_op"]
+
+
+def __getattr__(name):
+    # The reusable decode-step surface (one beam step as an explicit
+    # function of a carried-state pytree) lives in ops/generation_ops so
+    # the op kernel and the continuous-batching scheduler share ONE step
+    # definition; re-exported here lazily because ops imports jax and
+    # layers must stay importable before a backend is chosen.
+    if name in ("GenSpec", "DecodeState", "beam_step",
+                "find_generation_op", "gen_spec_from_op"):
+        from ..ops import generation_ops
+
+        return getattr(generation_ops, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 
 class _GenMemory:

@@ -8,9 +8,27 @@ Fluid equivalents: beam_search_op.cc / beam_search_decode_op.cc.
 
 The step network is a traced program sub-block (the generic analogue of
 the frame net), run on the flattened [B*K, ...] beam batch each scan step.
+
+The single decode step is factored out as `beam_step` with an explicit
+carried-state contract so TWO consumers compile the SAME math:
+
+- the `beam_search_group` kernel wraps it in a fixed-length lax.scan over
+  the whole request batch (batch-mode decode: every request rides the
+  scan for max_len steps regardless of when its beams finish);
+- `serving/scheduler.py` wraps it with slot masking into a pool step for
+  continuous batching (one step over a fixed pool of decode slots, new
+  requests admitted into slots freed by early-finishing ones).
+
+Sharing the step function is what makes the continuous scheduler's
+bit-identical-to-batch-mode guarantee testable rather than aspirational:
+the per-slot computation of a pool step IS the per-example computation of
+a scan step (every op in the step sub-block, plus log_softmax/top_k
+pruning, is independent along the example axis).
 """
 
 from __future__ import annotations
+
+from typing import Any, Dict, NamedTuple, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -19,26 +37,139 @@ from ..core.lod import LoDArray
 from ..core.registry import register_op
 from . import beam_common
 
+__all__ = [
+    "GenSpec",
+    "DecodeState",
+    "beam_step",
+    "find_generation_op",
+    "gen_spec_from_op",
+]
+
+
+class GenSpec(NamedTuple):
+    """Static description of one beam_search_group op — everything a
+    consumer needs to trace the step sub-block outside the op kernel."""
+
+    beam_size: int
+    max_len: int
+    bos_id: int
+    eos_id: int
+    length_normalize: bool
+    sub_block: int
+    prev_inner: str
+    mem_inner: Tuple[str, ...]
+    mem_update: Tuple[str, ...]
+    per_example: Tuple[str, ...]  # inner names the step body reads
+    logits_inner: str
+    boot_names: Tuple[str, ...]  # block-0 vars booting each memory
+    per_example_names: Tuple[str, ...]  # block-0 vars tiled to the beam
+    out_names: Tuple[str, str, str]  # (Ids, Scores, Lengths) var names
+
+
+class DecodeState(NamedTuple):
+    """Device-resident decode pool state — the carried-state pytree of
+    continuous batching. Leading axis S = number of slots; each slot is
+    one request example with K live hypotheses.
+
+    `parents`/`trellis_tok` are the (parent, token) trellis written one
+    column per step; a retiring slot is backtracked over its own
+    `step[s]` columns only, so stale columns from a previous occupant
+    are never read."""
+
+    mems: Tuple[jnp.ndarray, ...]  # each [S, K, ...]
+    tok: jnp.ndarray  # [S, K] int32 — token emitted at the last step
+    scores: jnp.ndarray  # [S, K] float32 cumulative log-probs
+    fin: jnp.ndarray  # [S, K] bool
+    step: jnp.ndarray  # [S] int32 — decode position per slot
+    parents: jnp.ndarray  # [S, K, T] int32 trellis
+    trellis_tok: jnp.ndarray  # [S, K, T] int32 trellis
+    pe: Tuple[jnp.ndarray, ...]  # per-example tensors, each [S*K, ...]
+
+
+def find_generation_op(program):
+    """The block-0 beam_search_group op, or None (non-generative model)."""
+    for op in program.global_block().ops:
+        if op.type == "beam_search_group":
+            return op
+    return None
+
+
+def gen_spec_from_op(op) -> GenSpec:
+    return GenSpec(
+        beam_size=int(op.attrs.get("beam_size", 4)),
+        max_len=int(op.attrs.get("max_len", 32)),
+        bos_id=int(op.attrs.get("bos_id", 0)),
+        eos_id=int(op.attrs.get("eos_id", 1)),
+        length_normalize=bool(op.attrs.get("length_normalize", False)),
+        sub_block=int(op.attrs["sub_block"]),
+        prev_inner=op.attrs["prev_inner"],
+        mem_inner=tuple(op.attrs.get("mem_inner", ())),
+        mem_update=tuple(op.attrs.get("mem_update", ())),
+        per_example=tuple(op.attrs.get("per_example", ())),
+        logits_inner=op.attrs["logits_inner"],
+        boot_names=tuple(op.inputs.get("Boot", [])),
+        per_example_names=tuple(op.inputs.get("PerExample", [])),
+        out_names=(
+            op.outputs["Ids"][0],
+            op.outputs["Scores"][0],
+            op.outputs["Lengths"][0],
+        ),
+    )
+
 
 def _tile_beam(x, K):
     """[B, ...] -> [B*K, ...] (repeat each example K times)."""
     return jnp.repeat(x, K, axis=0)
 
 
+def beam_step(runner, block, spec: GenSpec, env: Dict[str, Any],
+              mems, tok, sc, fin):
+    """ONE beam-search decode step over a [B, K] hypothesis batch.
+
+    `env` must already hold everything the step sub-block closes over:
+    parameters, per-example tensors tiled to [B*K, ...] under
+    `spec.per_example` names, plus @RNG@/@RNG_COUNTER@/@AMP@. It is
+    mutated (the sub-block ops write into it) — pass a per-step copy.
+
+    Returns (new_mems, new_tok, new_sc, new_fin, parent): the carried
+    state after expand/prune plus the parent pointers for the trellis.
+    """
+    B, K = tok.shape
+    env[spec.prev_inner] = tok.reshape(B * K)
+    for name, m in zip(spec.mem_inner, mems):
+        env[name] = m.reshape((B * K,) + m.shape[2:])
+    runner.run_ops(block.ops, env, dict(env), block)
+    logits = env[spec.logits_inner]
+    V = logits.shape[-1]
+    logits = logits.reshape(B, K, V).astype(jnp.float32)
+    new_mems = tuple(
+        jnp.where(
+            fin.reshape(B, K, *([1] * (m.ndim - 2))),
+            m,
+            env[u].reshape(m.shape),
+        )
+        for u, m in zip(spec.mem_update, mems)
+    )
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    logp = beam_common.freeze_finished(logp, fin, spec.eos_id)
+    top_sc, parent, new_tok = beam_common.expand_prune(sc, logp, K)
+    sel_mems = tuple(
+        jnp.take_along_axis(
+            m, parent.reshape(B, K, *([1] * (m.ndim - 2))), axis=1
+        )
+        for m in new_mems
+    )
+    fin_sel = jnp.take_along_axis(fin, parent, axis=1)
+    new_fin = fin_sel | (new_tok == spec.eos_id)
+    return sel_mems, new_tok, top_sc, new_fin, parent
+
+
 @register_op("beam_search_group")
 def beam_search_group_kernel(ctx):
     boots = ctx.inputs("Boot")
     per_example_vals = ctx.inputs("PerExample")
-    K = ctx.attr("beam_size", 4)
-    T = ctx.attr("max_len", 32)
-    bos = ctx.attr("bos_id", 0)
-    eos = ctx.attr("eos_id", 1)
-    norm_by_len = ctx.attr("length_normalize", False)
-    prev_inner = ctx.attr("prev_inner")
-    mem_inner = list(ctx.attr("mem_inner"))
-    mem_update = list(ctx.attr("mem_update"))
-    per_example = list(ctx.attr("per_example"))
-    logits_inner = ctx.attr("logits_inner")
+    spec = gen_spec_from_op(ctx.op)
+    K, T = spec.beam_size, spec.max_len
 
     if not boots:
         raise ValueError("beam_search_group needs at least one booted memory")
@@ -46,7 +177,7 @@ def beam_search_group_kernel(ctx):
     b0 = b0.data if isinstance(b0, LoDArray) else b0
     B = b0.shape[0]
 
-    block = ctx.executor.program.blocks[ctx.attr("sub_block")]
+    block = ctx.executor.program.blocks[spec.sub_block]
     outer_env = dict(ctx.env)
     # per-decode RNG stream (same per-frame freshness recurrent_ops gives):
     # consume one outer counter, fold the step index in inside the scan
@@ -55,7 +186,7 @@ def beam_search_group_kernel(ctx):
     )
     ctx.env["@RNG_COUNTER@"] = outer_env.get("@RNG_COUNTER@", 0) + 1
     # shadow per-example closure tensors with their beam-tiled versions
-    for name, v in zip(per_example, per_example_vals):
+    for name, v in zip(spec.per_example, per_example_vals):
         v = v.data if isinstance(v, LoDArray) else v
         outer_env[name] = _tile_beam(v, K)
 
@@ -64,7 +195,7 @@ def beam_search_group_kernel(ctx):
         bv = bv.data if isinstance(bv, LoDArray) else bv
         mems0.append(jnp.broadcast_to(bv[:, None], (B, K) + bv.shape[1:]))
 
-    tokens = jnp.full((B, K), bos, jnp.int32)
+    tokens = jnp.full((B, K), spec.bos_id, jnp.int32)
     scores = beam_common.init_scores(B, K)
     finished = jnp.zeros((B, K), bool)
 
@@ -73,32 +204,9 @@ def beam_search_group_kernel(ctx):
         env = dict(outer_env)
         env["@RNG@"] = jax.random.fold_in(base_key, t)
         env["@RNG_COUNTER@"] = 0
-        env[prev_inner] = tok.reshape(B * K)
-        for name, m in zip(mem_inner, mems):
-            env[name] = m.reshape((B * K,) + m.shape[2:])
-        ctx.executor.run_ops(block.ops, env, dict(env), block)
-        logits = env[logits_inner]
-        V = logits.shape[-1]
-        logits = logits.reshape(B, K, V).astype(jnp.float32)
-        new_mems = tuple(
-            jnp.where(
-                fin.reshape(B, K, *([1] * (m.ndim - 2))),
-                m,
-                env[u].reshape(m.shape),
-            )
-            for u, m in zip(mem_update, mems)
+        sel_mems, new_tok, top_sc, new_fin, parent = beam_step(
+            ctx.executor, block, spec, env, mems, tok, sc, fin
         )
-        logp = jax.nn.log_softmax(logits, axis=-1)
-        logp = beam_common.freeze_finished(logp, fin, eos)
-        top_sc, parent, new_tok = beam_common.expand_prune(sc, logp, K)
-        sel_mems = tuple(
-            jnp.take_along_axis(
-                m, parent.reshape(B, K, *([1] * (m.ndim - 2))), axis=1
-            )
-            for m in new_mems
-        )
-        fin_sel = jnp.take_along_axis(fin, parent, axis=1)
-        new_fin = fin_sel | (new_tok == eos)
         return (sel_mems, new_tok, top_sc, new_fin), (parent, new_tok)
 
     (_, _, final_scores, _), (parents, toks) = jax.lax.scan(
@@ -108,7 +216,7 @@ def beam_search_group_kernel(ctx):
 
     ids = beam_common.backtrack(parents, toks, B, K)
     ids, out_scores, lengths = beam_common.finalize(
-        ids, final_scores, eos, T, norm_by_len
+        ids, final_scores, spec.eos_id, T, spec.length_normalize
     )
 
     ctx.set_output("Ids", ids)

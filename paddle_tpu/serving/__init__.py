@@ -11,29 +11,42 @@ bounded). Layers, bottom-up:
               hit/miss accounting.
 - `batcher` — MicroBatcher: coalesces concurrent requests into one
               padded batch (queue + max_batch_size + max_wait_ms),
-              with bounded depth, deadlines, and load shedding.
+              with bounded depth, deadlines, and load shedding
+              (AdmissionQueue — the shared shed/deadline contract).
+- `scheduler` — ContinuousScheduler: token-level continuous batching
+              for generation models; a device-resident pool of decode
+              slots stepped as one jitted program, per-step admission,
+              early-exit compaction, streaming token events.
 - `server`  — ModelRegistry + threaded stdlib-HTTP JSON front-end
-              (/predict, /healthz, /stats, /metrics).
-- `metrics` — latency/batch histograms + Prometheus text export over
-              the existing profiler.StatSet plumbing.
+              (/predict, /generate incl. NDJSON streaming, /healthz,
+              /stats, /metrics).
+- `metrics` — latency/batch/first-token histograms + Prometheus text
+              export over the existing profiler.StatSet plumbing.
 
 CLI: `python -m paddle_tpu serve --model_dir <saved_inference_model>`.
 """
 
 from ..resilience.breaker import CircuitBreaker, CircuitOpenError  # noqa: F401
 from .engine import BucketPolicy, ServingEngine  # noqa: F401
-from .batcher import DeadlineError, MicroBatcher, ShedError  # noqa: F401
+from .batcher import (AdmissionQueue, DeadlineError,  # noqa: F401
+                      MicroBatcher, ShedError)
 from .metrics import Histogram, MetricSet  # noqa: F401
+from .scheduler import (ContinuousScheduler, GenerationAborted,  # noqa: F401
+                        GenHandle)
 from .server import ModelRegistry, ServingServer, make_server  # noqa: F401
 
 __all__ = [
     "BucketPolicy",
     "ServingEngine",
     "MicroBatcher",
+    "AdmissionQueue",
     "ShedError",
     "DeadlineError",
     "CircuitBreaker",
     "CircuitOpenError",
+    "ContinuousScheduler",
+    "GenHandle",
+    "GenerationAborted",
     "MetricSet",
     "Histogram",
     "ModelRegistry",

@@ -49,7 +49,7 @@ from ..resilience.breaker import CircuitBreaker, CircuitOpenError
 from .engine import ServingEngine
 from .metrics import MetricSet
 
-__all__ = ["MicroBatcher", "ShedError", "DeadlineError",
+__all__ = ["MicroBatcher", "AdmissionQueue", "ShedError", "DeadlineError",
            "CircuitOpenError"]
 
 
@@ -59,6 +59,80 @@ class ShedError(RuntimeError):
 
 class DeadlineError(RuntimeError):
     """The request's deadline passed before dispatch."""
+
+
+class AdmissionQueue:
+    """Bounded, deadline-aware FIFO — the admission half of the
+    MicroBatcher contract factored out so the generation path's
+    token-level scheduler shares the SAME shed/deadline semantics:
+
+    - `put()` rejects immediately with ShedError when `max_queue`
+      requests are waiting (503 + Retry-After, never an unbounded
+      backlog), counting `<prefix>shed_total`.
+    - `pop()` hands back the oldest request; requests found expired
+      are failed with DeadlineError (504) via their `fail()` and
+      counted as `<prefix>deadline_exceeded_total` — and, exactly like
+      MicroBatcher's post-engine re-check, the consumer is expected to
+      RE-CHECK `deadline` after slot admission / dispatch so a request
+      never receives a late first token its client already gave up on
+      (`expire()` is that re-check's failure path).
+
+    Items need two attributes: `deadline` (monotonic seconds) and
+    `fail(exc)` (terminal failure delivery). The caller supplies the
+    Condition so one lock can cover queue state plus whatever else the
+    consumer's worker loop sleeps on (e.g. decode-slot occupancy)."""
+
+    def __init__(self, max_queue: int, cond: threading.Condition,
+                 metrics: MetricSet, prefix: str = ""):
+        self.max_queue = max_queue
+        self.cond = cond
+        self.metrics = metrics
+        self.prefix = prefix
+        self._q: collections.deque = collections.deque()
+
+    def __len__(self) -> int:
+        with self.cond:
+            return len(self._q)
+
+    def depth(self) -> int:
+        return len(self._q)  # advisory (gauges); exact depth needs cond
+
+    def put(self, req) -> None:
+        """Enqueue or shed. Caller must NOT hold the condition."""
+        with self.cond:
+            if len(self._q) >= self.max_queue:
+                self.metrics.counter_inc(
+                    f"{self.prefix}shed_total",
+                    help="requests rejected because the queue was full")
+                raise ShedError(
+                    f"queue full ({self.max_queue} waiting); retry later")
+            self._q.append(req)
+            self.cond.notify_all()
+
+    def pop(self):
+        """Oldest non-expired request, or None. Expired requests are
+        failed (DeadlineError) and skipped. Caller holds the cond."""
+        while self._q:
+            req = self._q.popleft()
+            if req.deadline <= time.monotonic():
+                self.expire(req, "deadline exceeded while queued")
+                continue
+            return req
+        return None
+
+    def expire(self, req, msg: str) -> None:
+        """Fail one request on a missed deadline (shared by the queued
+        check in pop() and the consumer's post-admission re-check)."""
+        self.metrics.counter_inc(
+            f"{self.prefix}deadline_exceeded_total",
+            help="requests that expired before their result")
+        req.fail(DeadlineError(msg))
+
+    def drain(self, exc: Exception) -> None:
+        """Fail everything still queued (shutdown/abort)."""
+        with self.cond:
+            while self._q:
+                self._q.popleft().fail(exc)
 
 
 class _Request:

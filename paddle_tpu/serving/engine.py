@@ -155,6 +155,17 @@ class ServingEngine:
         # tuned-kernel provenance from meta.json (io.save_inference_model
         # since the tuner PR): exporter device_kind + table fingerprint
         self.tuning_meta = getattr(self.program, "_tuning_meta", None)
+        # generation sidecar (io.save_inference_model since the
+        # continuous-batching PR): beam geometry + decode-state specs so
+        # the scheduler can allocate its slot pool without re-tracing
+        self.generation_meta = getattr(self.program, "_generation_meta",
+                                       None)
+        from ..ops import generation_ops as _G
+
+        _gen_op = _G.find_generation_op(self.program)
+        self._gen_spec = (_G.gen_spec_from_op(_gen_op)
+                          if _gen_op is not None else None)
+        self._scheduler = None
         self.exe = Executor()
         self.metrics = metrics or MetricSet(
             stat_set=profiler.global_stat_set())
@@ -312,6 +323,42 @@ class ServingEngine:
         self._lat.observe(time.perf_counter() - t0)
         return outs
 
+    # -- generation (continuous batching) ------------------------------
+    def generation_spec(self):
+        """The model's beam_search_group GenSpec, or None for
+        feed-forward models."""
+        return self._gen_spec
+
+    def scheduler(self, **kwargs):
+        """The engine's ContinuousScheduler (created + started lazily;
+        kwargs apply on first call only — pass max_slots etc. up front
+        or build a ContinuousScheduler yourself)."""
+        if self._gen_spec is None:
+            raise ValueError(
+                f"model {self.model_name!r} is not a generation model "
+                "(no beam_search_group op)")
+        with self._lock:
+            if self._scheduler is None:
+                from .scheduler import ContinuousScheduler
+
+                self._scheduler = ContinuousScheduler(
+                    self, metrics=self.metrics, **kwargs)
+            elif kwargs:
+                raise ValueError(
+                    "scheduler already built; kwargs only apply on the "
+                    "first scheduler() call")
+            return self._scheduler.start()
+
+    def generate(self, feed: Dict[str, Any],
+                 timeout_ms: Optional[float] = None) -> Dict[str, Any]:
+        """Run one generation request through the continuous-batching
+        scheduler (token-level admission into a shared decode pool —
+        per-request results are bit-identical to the batch-mode
+        `predict()` decode). Returns {"ids": [n,K,T], "scores": [n,K],
+        "lengths": [n,K]}. For streaming, use
+        `scheduler().submit(feed).events()`."""
+        return self.scheduler().generate(feed, timeout_ms=timeout_ms)
+
     # ------------------------------------------------------------------
     def check_tuned_table(self) -> bool:
         """Compare the model's recorded tuning provenance (exporter
@@ -343,39 +390,167 @@ class ServingEngine:
             "via PT_TUNE_CACHE)", stacklevel=2)
         return False
 
-    def warmup(self) -> int:
+    def _zero_bucket_feed(self, nb: int, tb: Optional[int]):
+        """Zero feed at one (batch bucket, seq bucket) geometry, or None
+        when the model's feed shapes aren't fully concrete past the
+        batch axis (those buckets compile lazily)."""
+        pol = self.policy
+        feed = {}
+        for n in self.feed_names:
+            spec = self.feed_specs.get(n) or {}
+            dims = list(spec.get("shape", []))[1:]
+            if tb is not None and len(dims) >= pol.seq_axis:
+                dims[pol.seq_axis - 1] = tb
+            if any(not isinstance(d, int) or d <= 0 for d in dims):
+                return None
+            feed[n] = np.zeros(
+                (nb, *dims), np.dtype(spec.get("dtype", "float32")))
+        return feed
+
+    def warmup(self, tune_decode: Optional[bool] = None) -> int:
         """Pre-compile every bucket program derivable from the model's
         feed specs (zero feeds at each bucket geometry), so live
         traffic never pays a cold trace+compile — the CLI does this at
         startup. Also cross-checks the model's tuned-table provenance
         (check_tuned_table) so a stale table is warned about at startup,
-        not discovered in a latency regression. Returns the number of
-        bucket programs touched; models whose feed shapes aren't fully
-        concrete past the batch axis are skipped (their buckets compile
-        lazily)."""
+        not discovered in a latency regression.
+
+        For generation models the scheduler's slot machinery (pool
+        step + admit + per-bucket prefix programs) warms too, and
+        `tune_decode` controls the ROADMAP-4c slice: empirically tune
+        the decode-step kernels against the live bucket grid via
+        paddle_tpu.tune, populating the per-device table. Default None
+        = only on TPU (the harness refuses CPU timings); True warns and
+        skips when timing is unavailable rather than failing warmup.
+
+        Returns the number of bucket programs touched; models whose
+        feed shapes aren't fully concrete past the batch axis are
+        skipped (their buckets compile lazily)."""
         self.check_tuned_table()
         pol = self.policy
         compiled = 0
         for nb in pol.batch_buckets:
             for tb in (pol.seq_len_buckets or (None,)):
-                feed = {}
-                for n in self.feed_names:
-                    spec = self.feed_specs.get(n) or {}
-                    dims = list(spec.get("shape", []))[1:]
-                    if tb is not None and len(dims) >= pol.seq_axis:
-                        dims[pol.seq_axis - 1] = tb
-                    if any(not isinstance(d, int) or d <= 0
-                           for d in dims):
-                        feed = None
-                        break
-                    feed[n] = np.zeros(
-                        (nb, *dims),
-                        np.dtype(spec.get("dtype", "float32")))
+                feed = self._zero_bucket_feed(nb, tb)
                 if feed is None:
                     continue
                 self.predict(feed)
                 compiled += 1
+        if self._gen_spec is not None:
+            compiled += self.scheduler().warmup()
+            if tune_decode is None:
+                import jax
+
+                tune_decode = jax.default_backend() == "tpu"
+            if tune_decode:
+                self.tune_decode_kernels()
         return compiled
+
+    # -- decode-step kernel tuning (ROADMAP 4c slice) -------------------
+    def decode_tune_cases(self) -> List[Dict[str, Any]]:
+        """Tunable kernel sites of the decode step, expanded over the
+        live batch-bucket grid: the decode-step batch is
+        (bucket x beam_size) rows, a shape the offline `tune --config`
+        sweep cannot know (it sees -1 batch dims). Covers bahdanau
+        attention-GRU sites (both the fused train-side op and the
+        beam-search monolith) and static-shape flash_attention sites in
+        any block."""
+        from ..tune.space import pad_s
+
+        spec = self._gen_spec
+        amp = "bfloat16" if getattr(self.program, "amp_dtype", None) \
+            else "float32"
+        out: List[Dict[str, Any]] = []
+
+        def var_shape(block, name):
+            try:
+                return [int(d) for d in block.var(name).shape]
+            except (KeyError, TypeError, ValueError):
+                return None
+
+        K = spec.beam_size if spec is not None else 1
+        for block in self.program.blocks:
+            for op in block.ops:
+                if op.type in ("attention_gru_decoder",
+                               "attention_gru_beam_search"):
+                    enc = var_shape(block, op.inputs["EncState"][0])
+                    wa = var_shape(block, op.inputs["WaEnc"][0])
+                    src = int(op.attrs.get("src_max_len") or 0)
+                    if not enc or not wa or src <= 0:
+                        continue
+                    kk = int(op.attrs.get("beam_size", K)) \
+                        if op.type == "attention_gru_beam_search" else K
+                    for nb in self.policy.batch_buckets:
+                        out.append({
+                            "family": "bahdanau_attention",
+                            "params": {"B": nb * kk, "Sp": pad_s(src),
+                                       "A": wa[1], "C": enc[-1]},
+                            "dtype": amp, "op": op.type})
+                elif op.type == "flash_attention":
+                    s = var_shape(block, op.inputs["Q"][0])
+                    k = var_shape(block, op.inputs["K"][0])
+                    if not s or not k or len(s) < 3 or s[1] <= 0 \
+                            or k[1] <= 0:
+                        continue
+                    out.append({"family": "flash_attention",
+                                "params": {"Tq": s[1], "Tk": k[1]},
+                                "dtype": amp, "op": op.type})
+        # dedupe (several buckets/ops can land on one shape signature)
+        seen, uniq = set(), []
+        for c in out:
+            key = (c["family"], tuple(sorted(c["params"].items())),
+                   c["dtype"])
+            if key not in seen:
+                seen.add(key)
+                uniq.append(c)
+        return uniq
+
+    def tune_decode_kernels(self, require_tpu: bool = True,
+                            iters: int = 5, warmup: int = 2
+                            ) -> List[Dict[str, Any]]:
+        """Consult/populate the per-device tuned table for every
+        decode-step kernel shape the bucket grid can dispatch
+        (CLBlast's per-device database, applied at serving warmup so
+        production configs are tuned configs). Already-tuned shapes are
+        skipped (the table is the cache); off-TPU the harness refuses
+        and this warns + returns what it skipped instead of failing
+        startup."""
+        from ..tune import harness as tune_harness
+        from ..tune import overrides as tune_overrides
+        from ..tune import space as tune_space
+
+        table = tune_overrides.table()
+        reports: List[Dict[str, Any]] = []
+        for case in self.decode_tune_cases():
+            try:
+                fam = tune_space.get_family(case["family"])
+                norm = fam.normalize(case["params"], case["dtype"])
+            except (KeyError, ValueError) as e:
+                reports.append({**case, "status": f"ineligible: {e}"})
+                continue
+            if table.get(fam.name, norm, case["dtype"]) is not None:
+                reports.append({**case, "status": "cached"})
+                continue
+            try:
+                r = tune_harness.tune_case(
+                    case["family"], case["params"], case["dtype"],
+                    table=table, iters=iters, warmup=warmup,
+                    require_tpu=require_tpu)
+            except tune_harness.TuningUnavailable as e:
+                import warnings
+
+                warnings.warn(
+                    f"decode-step tuning skipped for model "
+                    f"{self.model_name!r}: {e}", stacklevel=2)
+                reports.append({**case, "status": "unavailable"})
+                break
+            except ValueError as e:
+                # shape outside the kernel's eligibility: analytic path
+                reports.append({**case, "status": f"ineligible: {e}"})
+                continue
+            reports.append({**case, "status": "tuned",
+                            "best": r.get("best")})
+        return reports
 
     def compiled_programs(self) -> int:
         """Number of XLA programs the underlying Executor holds."""
@@ -403,4 +578,6 @@ class ServingEngine:
                 "bucket_counts": {
                     str(k[1]): c for k, c in self._seen_buckets.items()
                 },
+                **({"generation": self._scheduler.stats()}
+                   if self._scheduler is not None else {}),
             }

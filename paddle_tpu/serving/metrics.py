@@ -22,12 +22,26 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 from ..profiler import StatSet
 
-__all__ = ["Histogram", "MetricSet", "DEFAULT_LATENCY_BUCKETS"]
+__all__ = ["Histogram", "MetricSet", "DEFAULT_LATENCY_BUCKETS",
+           "FIRST_TOKEN_BUCKETS", "TOKEN_INTERVAL_BUCKETS"]
 
 # seconds; spans sub-ms CPU fc models to multi-second cold compiles
 DEFAULT_LATENCY_BUCKETS = (
     0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
     1.0, 2.5, 5.0, 10.0, 30.0,
+)
+
+# generation-serving latency grids (continuous batching): first-token
+# latency is queue wait + prefix run + one pool step (ms to seconds —
+# a cold compile lands in the tail buckets and is visible as such);
+# the inter-token interval is ~one pool step (sub-ms to tens of ms).
+FIRST_TOKEN_BUCKETS = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+    1.0, 2.5, 5.0, 10.0,
+)
+TOKEN_INTERVAL_BUCKETS = (
+    0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+    0.5, 1.0,
 )
 
 

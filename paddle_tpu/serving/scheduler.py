@@ -1,0 +1,773 @@
+"""Continuous batching for generation serving: token-level scheduler
+with device-resident decode state.
+
+Why request-granularity batching loses on generation: the batch-mode
+`beam_search_group` program scans for `max_len` steps no matter when a
+request's beams finish, so a padded slot does max_len steps of work to
+produce avg_len useful tokens, and a new request waits for the WHOLE
+batch to drain before it can start (PERF.md measures the ragged-batch
+analogue of this waste at 1.48-1.59x on training inputs; generation
+adds the drain-latency term on top).
+
+The continuous scheduler inverts the loop: a fixed pool of `max_slots`
+decode slots whose state (beam memories, cumulative scores, the
+(parent, token) trellis) stays ON DEVICE between steps as one
+`DecodeState` pytree. Each iteration:
+
+  1. ADMIT  — queued requests occupy free slots (the model's encoder
+              prefix runs once per request through the engine's shape
+              buckets; boot states are written into the pool by a
+              jitted dynamic-update).
+  2. STEP   — ONE jitted pool step advances every active slot by one
+              token (the same `beam_step` the batch kernel scans —
+              per-slot math is bit-identical to batch-mode decode).
+  3. STREAM — the current best-beam token of every active slot is
+              pushed to its request's event queue (provisional until
+              the final backtrack, as in any beam-search streamer).
+  4. RETIRE — slots whose beams all finished (or hit max_len) are
+              backtracked, their results delivered, and the slot freed
+              for the next admission — early-exit compaction: a short
+              request never pays for a long neighbour.
+
+Deadline/shed semantics mirror the MicroBatcher contract: a bounded
+admission queue sheds with ShedError/503, deadlines are checked at
+admission AND re-checked after slot admission/first step so a request
+never streams a late first token past its deadline (DeadlineError/504).
+A shared per-model CircuitBreaker (resilience.breaker) counts step
+failures so /generate trips the same breaker /predict does. The
+`serving.predict` fault point is fired each pool step: an injected
+fault aborts in-flight requests with GenerationAborted (503, retryable)
+and recovers the slots for subsequent traffic.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..resilience import faults
+from ..resilience.breaker import CircuitBreaker, CircuitOpenError
+from .batcher import AdmissionQueue, DeadlineError, ShedError
+from .metrics import (FIRST_TOKEN_BUCKETS, TOKEN_INTERVAL_BUCKETS,
+                      MetricSet)
+
+__all__ = ["ContinuousScheduler", "GenHandle", "GenerationAborted",
+           "DeadlineError", "ShedError", "CircuitOpenError"]
+
+
+class GenerationAborted(ShedError):
+    """A pool step failed mid-flight: the request was aborted, slots
+    recovered — retry (maps to HTTP 503 + Retry-After)."""
+
+
+class GenHandle:
+    """Client-side handle for one generation request.
+
+    `events()` yields dicts as decoding progresses:
+      {"event": "token", "row": r, "step": t, "token": id}   per step
+      {"event": "done",  "outputs": {...}}                   terminal
+      {"event": "error", "error": msg, "kind": clsname}      terminal
+    `result()` blocks to the terminal event and returns the outputs
+    dict (ids [n,K,T], scores [n,K], lengths [n,K]) or raises."""
+
+    def __init__(self, rows: int):
+        self.rows = rows
+        self._q: "queue.Queue[dict]" = queue.Queue()
+        self._done = threading.Event()
+        self._outputs: Optional[Dict[str, np.ndarray]] = None
+        self._exc: Optional[BaseException] = None
+
+    # -- scheduler side -------------------------------------------------
+    def _emit_token(self, row: int, step: int, token: int) -> None:
+        self._q.put({"event": "token", "row": row, "step": step,
+                     "token": token})
+
+    def _finish(self, outputs: Dict[str, np.ndarray]) -> None:
+        self._outputs = outputs
+        self._done.set()
+        self._q.put({"event": "done", "outputs": outputs})
+
+    def _fail(self, exc: BaseException) -> None:
+        if self._done.is_set():
+            return
+        self._exc = exc
+        self._done.set()
+        self._q.put({"event": "error", "error": str(exc),
+                     "kind": type(exc).__name__})
+
+    # -- client side ----------------------------------------------------
+    def events(self, timeout: Optional[float] = None):
+        while True:
+            ev = self._q.get(timeout=timeout)
+            yield ev
+            if ev["event"] in ("done", "error"):
+                return
+
+    def result(self, timeout: Optional[float] = None) -> Dict[str, np.ndarray]:
+        if not self._done.wait(timeout=timeout):
+            raise DeadlineError("generation result timed out")
+        if self._exc is not None:
+            raise self._exc
+        assert self._outputs is not None
+        return self._outputs
+
+
+class _GenRequest:
+    __slots__ = ("feed", "rows", "handle", "deadline", "submitted_at",
+                 "first_token_at", "last_token_at", "boots", "pes",
+                 "next_row", "live_rows", "results", "failed")
+
+    def __init__(self, feed, rows: int, deadline: float):
+        self.feed = feed
+        self.rows = rows
+        self.handle = GenHandle(rows)
+        self.deadline = deadline
+        self.submitted_at = time.monotonic()
+        self.first_token_at: Optional[float] = None
+        self.last_token_at: Optional[float] = None
+        self.boots = None  # prefix outputs, set at first admission
+        self.pes = None
+        self.next_row = 0  # next un-admitted row
+        self.live_rows = 0  # rows currently holding slots
+        self.results: Dict[int, tuple] = {}  # row -> (ids, scores, lengths)
+        self.failed = False
+
+    def fail(self, exc: BaseException) -> None:
+        """Terminal failure (AdmissionQueue contract + scheduler paths)."""
+        self.failed = True
+        self.handle._fail(exc)
+
+
+class ContinuousScheduler:
+    """Token-level continuous-batching scheduler over one engine's
+    generative model. One worker thread owns the decode pool; any
+    number of client threads submit()."""
+
+    def __init__(
+        self,
+        engine,
+        max_slots: int = 8,
+        max_queue: int = 64,
+        timeout_ms: float = 30000.0,
+        breaker: Optional[CircuitBreaker] = None,
+        metrics: Optional[MetricSet] = None,
+    ):
+        from ..ops import generation_ops as G
+
+        self.engine = engine
+        op = G.find_generation_op(engine.program)
+        if op is None:
+            raise ValueError(
+                f"model {engine.model_name!r} has no beam_search_group "
+                "op — continuous batching serves generation programs "
+                "(layers.BeamSearchDecoder); use predict() for "
+                "feed-forward models")
+        self._G = G
+        self.spec = G.gen_spec_from_op(op)
+        block0 = engine.program.global_block()
+        gen_idx = block0.ops.index(op)
+        if any(o.type != "beam_search_group" for o in block0.ops[gen_idx + 1:]):
+            raise ValueError(
+                "ops after the beam_search_group op are not supported by "
+                "the continuous scheduler (its outputs feed post-decode "
+                "ops the pool step cannot incrementalize)")
+        self._prefix_ops = block0.ops[:gen_idx]
+        self._block0 = block0
+        self._check_step_closures(engine.program)
+        if max_slots < 1:
+            raise ValueError(f"max_slots must be >= 1, got {max_slots}")
+        self.max_slots = max_slots
+        self.max_queue = max_queue
+        self.timeout_s = timeout_ms / 1e3
+        self.breaker = breaker
+        self.metrics = metrics or engine.metrics
+
+        import jax
+
+        self._jax = jax
+        # persistables snapshot: generation serving assumes frozen
+        # weights (the engine contract); committed once, passed to every
+        # jitted call so jit never re-traces on placement
+        scope = engine.scope
+        self._params = {
+            v.name: jax.device_put(scope.get(v.name))
+            for v in engine.program.persistables() if scope.has(v.name)
+        }
+
+        # pool state (allocated on first admission or warmup-from-meta)
+        self._state = None  # DecodeState
+        self._mem_specs = None  # ((trailing shape, dtype), ...)
+        self._pe_specs = None
+        self._pool_step = None  # jitted (params, active, state) -> state
+        self._pool_admit = None  # jitted (state, slot, boots, pes) -> state
+        self._prefix_cache: Dict[tuple, Any] = {}
+        self.compiles = 0
+
+        self._cond = threading.Condition()
+        # the admission queue shares MicroBatcher's deadline/shed
+        # semantics (serving/batcher.py) — one contract for both paths
+        self._aq = AdmissionQueue(max_queue, self._cond, self.metrics,
+                                  prefix="gen_")
+        self._slot_req: List[Optional[Tuple[_GenRequest, int]]] = (
+            [None] * max_slots)
+        self._active = np.zeros(max_slots, bool)
+        self._partial: Optional[_GenRequest] = None  # rows still waiting
+        self._worker: Optional[threading.Thread] = None
+        self._stopping = False
+
+        # accounting (engine-parity dispatch/sync counters + gen stats)
+        self.dispatches_total = 0
+        self.syncs_total = 0
+        self.steps_total = 0
+        self.admitted_total = 0
+        self.retired_total = 0
+        self.tokens_total = 0
+        self._occupancy_steps = 0  # sum of active-slot count over steps
+        self._first_tok = self.metrics.histogram(
+            "gen_first_token_seconds", buckets=FIRST_TOKEN_BUCKETS,
+            help="submit-to-first-streamed-token latency")
+        self._per_tok = self.metrics.histogram(
+            "gen_token_seconds", buckets=TOKEN_INTERVAL_BUCKETS,
+            help="inter-token interval per request")
+        self.metrics.gauge(
+            "gen_slot_occupancy",
+            lambda: float(self._active.sum()) / self.max_slots,
+            help="fraction of decode slots occupied")
+        self.metrics.gauge(
+            "gen_queue_depth", lambda: self._aq.depth(),
+            help="generation requests waiting for a slot")
+
+    def _check_step_closures(self, program) -> None:
+        """The pool-step env holds parameters and declared per-example
+        tensors ONLY (batch-mode decode sees the whole block-0 env, so
+        it tolerates undeclared closures the scheduler cannot): reject
+        step bodies that close over other outer values up front, with a
+        fix, instead of a KeyError mid-trace."""
+        spec = self.spec
+        persist = {v.name for v in program.persistables()}
+        produced = ({spec.prev_inner} | set(spec.mem_inner)
+                    | set(spec.per_example))
+        refs: set = set()
+        stack = [spec.sub_block]
+        while stack:
+            b = program.blocks[stack.pop()]
+            for sop in b.ops:
+                refs.update(n for n in sop.input_names()
+                            if n not in produced)
+                produced.update(sop.output_names())
+                inner = sop.attrs.get("sub_block")
+                if isinstance(inner, int):
+                    stack.append(inner)
+        missing = sorted(refs - persist)
+        if missing:
+            raise ValueError(
+                f"generation step body closes over non-parameter outer "
+                f"value(s) {missing}: continuous batching keeps only "
+                "parameters and declared per-example tensors device-"
+                "resident — declare them with gen.per_example_input()")
+
+    # -- lifecycle ------------------------------------------------------
+    def start(self) -> "ContinuousScheduler":
+        with self._cond:
+            if self._worker is not None and self._worker.is_alive():
+                return self
+            self._stopping = False
+            self._worker = threading.Thread(
+                target=self._run,
+                name=f"ptgen-{self.engine.model_name}", daemon=True)
+            self._worker.start()
+        return self
+
+    def stop(self, drain: bool = False) -> None:
+        if drain:
+            deadline = time.monotonic() + 60.0
+            while time.monotonic() < deadline:
+                with self._cond:
+                    if not self._aq._q and not self._active.any() \
+                            and self._partial is None:
+                        break
+                time.sleep(0.01)
+        with self._cond:
+            self._stopping = True
+            self._cond.notify_all()
+        if self._worker is not None:
+            self._worker.join(timeout=10.0)
+        # fail whatever is still queued/in flight
+        self._drain_queue(ShedError("scheduler stopped"))
+        with self._cond:
+            self._abort_inflight_locked(ShedError("scheduler stopped"))
+
+    # -- client side ----------------------------------------------------
+    def submit(self, feed: Dict[str, np.ndarray],
+               timeout_ms: Optional[float] = None) -> GenHandle:
+        if self.breaker is not None and not self.breaker.admit():
+            self.metrics.counter_inc(
+                "circuit_open_total",
+                help="requests rejected because the model's circuit "
+                     "breaker was open")
+            raise CircuitOpenError(
+                f"circuit open for model {self.engine.model_name!r}; "
+                "retry later")
+        rows = {v.shape[0] for v in feed.values()
+                if hasattr(v, "ndim") and v.ndim >= 1}
+        if len(rows) != 1:
+            raise ValueError(
+                f"generation feeds must share the batch axis; got row "
+                f"counts {sorted(rows)}")
+        n = rows.pop()
+        deadline = time.monotonic() + (
+            timeout_ms / 1e3 if timeout_ms is not None else self.timeout_s)
+        req = _GenRequest(feed, n, deadline)
+        with self._cond:
+            if self._stopping:
+                raise ShedError("scheduler stopped")
+        self._aq.put(req)  # sheds with ShedError/503 when full
+        self.metrics.counter_inc(
+            "gen_requests_total", help="generation requests accepted")
+        return req.handle
+
+    def generate(self, feed: Dict[str, np.ndarray],
+                 timeout_ms: Optional[float] = None) -> Dict[str, np.ndarray]:
+        """submit + wait: the non-streaming convenience used by
+        ServingEngine.generate(). Grace mirrors MicroBatcher.predict
+        (cold pool-step compiles can outlast the deadline alone)."""
+        h = self.submit(feed, timeout_ms=timeout_ms)
+        budget = (timeout_ms / 1e3 if timeout_ms is not None
+                  else self.timeout_s)
+        return h.result(timeout=budget + max(1.0, budget))
+
+    # -- pool construction ---------------------------------------------
+    def _build_prefix(self, padded: Dict[str, Any]):
+        """Jitted encoder prefix: (params, feed) -> (boots, pes); one
+        compile per engine shape bucket (the slot-state compile cache is
+        keyed off the SAME buckets predict uses)."""
+        from ..core.executor import _BlockRunner, _feed_signature
+
+        key = _feed_signature(padded)
+        fn = self._prefix_cache.get(key)
+        if fn is not None:
+            return fn
+        jax, jnp = self._jax, self._jax.numpy
+        runner = _BlockRunner(self.engine.program)
+        spec, block0, ops = self.spec, self._block0, self._prefix_ops
+        amp = self.engine.program.amp_dtype
+
+        def prefix(params, feed):
+            env = dict(params)
+            env.update(feed)
+            env["@RNG@"] = jax.random.PRNGKey(0)
+            env["@RNG_COUNTER@"] = 0
+            env["@AMP@"] = amp
+            runner.run_ops(ops, env, dict(env), block0)
+            boots = tuple(env[n] for n in spec.boot_names)
+            pes = tuple(env[n] for n in spec.per_example_names)
+            return boots, pes
+
+        fn = jax.jit(prefix)
+        self._prefix_cache[key] = fn
+        self.compiles += 1
+        return fn
+
+    def _ensure_pool(self, mem_specs, pe_specs) -> None:
+        """Allocate the DecodeState pool + compile step/admit for these
+        per-slot trailing shapes (once per model: the decode state
+        geometry is fixed by the program, not by traffic)."""
+        if self._state is not None:
+            if (mem_specs, pe_specs) != (self._mem_specs, self._pe_specs):
+                raise ValueError(
+                    f"generation state geometry changed mid-serve: pool "
+                    f"holds {self._mem_specs}/{self._pe_specs}, request "
+                    f"produced {mem_specs}/{pe_specs} — decode-state "
+                    "trailing shapes must be static (pad variable-length "
+                    "encoder outputs to a fixed bucket)")
+            return
+        jax, jnp = self._jax, self._jax.numpy
+        from ..core.executor import _BlockRunner
+        from ..ops import beam_common
+
+        G, spec, S = self._G, self.spec, self.max_slots
+        K, T = spec.beam_size, spec.max_len
+        self._mem_specs, self._pe_specs = mem_specs, pe_specs
+        self._state = G.DecodeState(
+            mems=tuple(jnp.zeros((S, K) + shp, dt) for shp, dt in mem_specs),
+            tok=jnp.full((S, K), spec.bos_id, jnp.int32),
+            scores=jnp.zeros((S, K), jnp.float32),
+            fin=jnp.ones((S, K), bool),
+            step=jnp.zeros((S,), jnp.int32),
+            parents=jnp.zeros((S, K, T), jnp.int32),
+            trellis_tok=jnp.full((S, K, T), spec.eos_id, jnp.int32),
+            pe=tuple(jnp.zeros((S * K,) + shp, dt) for shp, dt in pe_specs),
+        )
+        runner = _BlockRunner(self.engine.program)
+        block = self.engine.program.blocks[spec.sub_block]
+        amp = self.engine.program.amp_dtype
+
+        def pool_step(params, active, state):
+            env = dict(params)
+            env["@RNG@"] = jax.random.PRNGKey(0)
+            env["@RNG_COUNTER@"] = 0
+            env["@AMP@"] = amp
+            for name, v in zip(spec.per_example, state.pe):
+                env[name] = v
+            new_mems, new_tok, new_sc, new_fin, parent = G.beam_step(
+                runner, block, spec, env,
+                state.mems, state.tok, state.scores, state.fin)
+            u2 = active[:, None]
+            mems = tuple(
+                jnp.where(active.reshape((S,) + (1,) * (m.ndim - 1)), nm, m)
+                for nm, m in zip(new_mems, state.mems))
+            tok = jnp.where(u2, new_tok, state.tok)
+            sc = jnp.where(u2, new_sc, state.scores)
+            fin = jnp.where(u2, new_fin, state.fin)
+            at_t = (jnp.arange(T)[None, None, :]
+                    == state.step[:, None, None]) & active[:, None, None]
+            parents = jnp.where(at_t, parent[:, :, None], state.parents)
+            ttok = jnp.where(at_t, new_tok[:, :, None], state.trellis_tok)
+            stp = state.step + active.astype(jnp.int32)
+            return G.DecodeState(mems, tok, sc, fin, stp, parents, ttok,
+                                 state.pe)
+
+        def pool_admit(state, slot, boots, pe_rows):
+            mems = tuple(
+                jax.lax.dynamic_update_index_in_dim(
+                    m, jnp.broadcast_to(b, (K,) + b.shape), slot, 0)
+                for m, b in zip(state.mems, boots))
+            tok = jax.lax.dynamic_update_index_in_dim(
+                state.tok, jnp.full((K,), spec.bos_id, jnp.int32), slot, 0)
+            sc = jax.lax.dynamic_update_index_in_dim(
+                state.scores, beam_common.init_scores(1, K)[0], slot, 0)
+            fin = jax.lax.dynamic_update_index_in_dim(
+                state.fin, jnp.zeros((K,), bool), slot, 0)
+            stp = jax.lax.dynamic_update_index_in_dim(
+                state.step, jnp.zeros((), jnp.int32), slot, 0)
+            pe = tuple(
+                jax.lax.dynamic_update_slice_in_dim(
+                    p, jnp.repeat(r[None], K, axis=0), slot * K, axis=0)
+                for p, r in zip(state.pe, pe_rows))
+            # parents/trellis_tok stay stale: the pool step overwrites
+            # columns 0..t-1 before retirement ever backtracks them
+            return state._replace(mems=mems, tok=tok, scores=sc, fin=fin,
+                                  step=stp, pe=pe)
+
+        self._pool_step = jax.jit(pool_step)
+        self._pool_admit = jax.jit(pool_admit)
+        self.compiles += 2
+
+    def warmup(self) -> int:
+        """Pre-compile the slot machinery so the first live request
+        never pays the pool-step trace: prefix programs for every feed
+        bucket (zero feeds, exactly like ServingEngine.warmup) and —
+        when the artifact's meta.json records generation-state specs
+        (io.save_inference_model) — the pool step + admit programs,
+        without running any request through the model source.
+        Returns the number of programs compiled."""
+        before = self.compiles
+        meta = getattr(self.engine.program, "_generation_meta", None)
+        if meta and self._state is None:
+            try:
+                mem_specs = tuple(
+                    (tuple(int(d) for d in m["shape"]), np.dtype(m["dtype"]))
+                    for m in meta.get("state", []))
+                pe_specs = tuple(
+                    (tuple(int(d) for d in m["shape"]), np.dtype(m["dtype"]))
+                    for m in meta.get("per_example", []))
+                self._ensure_pool(mem_specs, pe_specs)
+            except (KeyError, TypeError, ValueError) as e:
+                import warnings
+
+                warnings.warn(
+                    f"generation meta of model "
+                    f"{self.engine.model_name!r} unusable for pool "
+                    f"warmup ({e}); slot state compiles on first "
+                    "request", stacklevel=2)
+        if self._state is not None:
+            # trace+compile step and admit against the real pool state
+            jnp = self._jax.numpy
+            active = jnp.zeros((self.max_slots,), bool)
+            self._state = self._pool_step(self._params, active, self._state)
+            boots = tuple(jnp.zeros(shp, dt) for shp, dt in self._mem_specs)
+            pes = tuple(jnp.zeros(shp, dt) for shp, dt in self._pe_specs)
+            self._state = self._pool_admit(
+                self._state, jnp.int32(0), boots, pes)
+            # leave the pool empty: the warmup admit wrote slot 0 but
+            # _active stays False so its garbage never steps or retires
+        pol = self.engine.policy
+        for nb in pol.batch_buckets:
+            for tb in (pol.seq_len_buckets or (None,)):
+                feed = self.engine._zero_bucket_feed(nb, tb)
+                if feed is None:
+                    continue
+                self._build_prefix(
+                    {k: self._jax.numpy.asarray(v) for k, v in feed.items()})
+        return self.compiles - before
+
+    # -- worker ---------------------------------------------------------
+    def _run(self) -> None:
+        while True:
+            with self._cond:
+                while (not self._aq._q and not self._active.any()
+                       and self._partial is None and not self._stopping):
+                    self._cond.wait()
+                if self._stopping:
+                    return
+            try:
+                self._admit_ready()
+            except Exception:
+                # per-request admission failures are delivered on the
+                # request handle inside _admit_ready; anything reaching
+                # here is a scheduler bug — surface it on every handle
+                import traceback
+
+                traceback.print_exc()
+            if self._active.any():
+                self._step_once()
+            else:
+                time.sleep(0.001)  # queue non-empty but nothing admitted
+
+    def _free_slots(self) -> List[int]:
+        return [i for i in range(self.max_slots) if not self._active[i]]
+
+    def _admit_ready(self) -> None:
+        free = self._free_slots()
+        while free:
+            req = self._partial
+            if req is None:
+                with self._cond:
+                    # pop() fails already-expired requests with
+                    # DeadlineError (the queued-side deadline check)
+                    req = self._aq.pop()
+                if req is None:
+                    return
+                try:
+                    self._run_prefix(req)
+                except Exception as e:
+                    req.fail(e)
+                    free = self._free_slots()
+                    continue
+            admitted_any = False
+            while free and req.next_row < req.rows:
+                slot = free.pop(0)
+                row = req.next_row
+                self._admit_row(req, row, slot)
+                req.next_row += 1
+                req.live_rows += 1
+                admitted_any = True
+            self._partial = req if req.next_row < req.rows else None
+            # deadline RE-CHECK after slot admission: the prefix run (a
+            # possible cold bucket compile) may have eaten the budget —
+            # free the slots now rather than stream a late first token
+            if admitted_any and req.first_token_at is None \
+                    and req.deadline <= time.monotonic():
+                self._evict_request(req)
+                self._deadline_fail(req, "deadline exceeded during slot "
+                                         "admission (cold compile? warm "
+                                         "the engine)")
+            free = self._free_slots()
+            if self._partial is not None:
+                return  # head-of-line request still owns the next slots
+
+    def _run_prefix(self, req: _GenRequest) -> None:
+        padded, n, _ = self.engine._pad_feed(
+            {k: np.asarray(v) for k, v in req.feed.items()})
+        jnp = self._jax.numpy
+        padded = {k: jnp.asarray(v) for k, v in padded.items()}
+        fn = self._build_prefix(padded)
+        boots, pes = fn(self._params, padded)
+        mem_specs = tuple((tuple(b.shape[1:]), np.dtype(b.dtype))
+                          for b in boots)
+        pe_specs = tuple((tuple(p.shape[1:]), np.dtype(p.dtype))
+                         for p in pes)
+        self._ensure_pool(mem_specs, pe_specs)
+        req.boots = boots  # [nb, ...] device arrays; rows sliced on admit
+        req.pes = pes
+        self.dispatches_total += 1
+
+    def _admit_row(self, req: _GenRequest, row: int, slot: int) -> None:
+        jnp = self._jax.numpy
+        boots = tuple(b[row] for b in req.boots)
+        pes = tuple(p[row] for p in req.pes)
+        self._state = self._pool_admit(
+            self._state, jnp.int32(slot), boots, pes)
+        self._slot_req[slot] = (req, row)
+        self._active[slot] = True
+        self.admitted_total += 1
+
+    def _step_once(self) -> None:
+        jnp = self._jax.numpy
+        try:
+            # the same chaos point engine.predict fires: a generation
+            # step failure must fan out, feed the breaker, and free the
+            # pool — never wedge the worker thread
+            faults.fire("serving.predict", model=self.engine.model_name,
+                        path="generate")
+            active = jnp.asarray(self._active)
+            self._state = self._pool_step(self._params, active, self._state)
+            # ONE host fence for everything the streaming loop reads —
+            # three separate np.asarray calls would pay three d2h
+            # round-trips per decode step
+            tok, fin, stp = self._jax.device_get(
+                (self._state.tok, self._state.fin, self._state.step))
+        except Exception as e:
+            if self.breaker is not None:
+                self.breaker.record_failure()
+            with self._cond:
+                self._abort_inflight_locked(GenerationAborted(
+                    f"generation pool step failed "
+                    f"({type(e).__name__}: {e}); in-flight requests "
+                    "aborted, slots recovered — retry"))
+            return
+        self.dispatches_total += 1
+        self.syncs_total += 1
+        self.steps_total += 1
+        self._occupancy_steps += int(self._active.sum())
+        self.metrics.counter_inc(
+            "gen_steps_total", help="decode pool steps executed")
+        now = time.monotonic()
+        for slot in range(self.max_slots):
+            if not self._active[slot]:
+                continue
+            req, row = self._slot_req[slot]
+            t = int(stp[slot])
+            if req.first_token_at is None and req.deadline <= now:
+                # satellite contract: a late FIRST token is never
+                # streamed — the client already gave up
+                self._evict_request(req)
+                self._deadline_fail(req, "deadline exceeded before the "
+                                         "first token (cold pool-step "
+                                         "compile? warm the engine)")
+                continue
+            if req.first_token_at is None:
+                req.first_token_at = now
+                self._first_tok.observe(now - req.submitted_at)
+            if req.last_token_at is not None:
+                self._per_tok.observe(now - req.last_token_at)
+            req.last_token_at = now
+            self.tokens_total += 1
+            self.metrics.counter_inc(
+                "gen_tokens_total",
+                help="tokens streamed across all generation requests")
+            req.handle._emit_token(row, t - 1, int(tok[slot, 0]))
+            if bool(fin[slot].all()) or t >= self.spec.max_len:
+                self._retire(slot, req, row, t)
+
+    def _retire(self, slot: int, req: _GenRequest, row: int,
+                t_star: int) -> None:
+        """Early-exit compaction: backtrack THIS slot's trellis over its
+        own t* steps, deliver, and free the slot immediately — the rest
+        of the pool keeps decoding."""
+        parents = np.asarray(self._state.parents[slot])  # [K, T]
+        toks = np.asarray(self._state.trellis_tok[slot])
+        scores = np.asarray(self._state.scores[slot])
+        ids, out_scores, lengths = _finalize_slot(
+            parents, toks, scores, t_star, self.spec)
+        req.results[row] = (ids, out_scores, lengths)
+        self._active[slot] = False
+        self._slot_req[slot] = None
+        req.live_rows -= 1
+        self.retired_total += 1
+        if len(req.results) == req.rows and not req.failed:
+            outs = {
+                "ids": np.stack(
+                    [req.results[r][0] for r in range(req.rows)]),
+                "scores": np.stack(
+                    [req.results[r][1] for r in range(req.rows)]),
+                "lengths": np.stack(
+                    [req.results[r][2] for r in range(req.rows)]),
+            }
+            if self.breaker is not None:
+                self.breaker.record_success()
+            req.handle._finish(outs)
+
+    # -- failure paths --------------------------------------------------
+    def _deadline_fail(self, req: _GenRequest, msg: str) -> None:
+        # post-admission deadline re-check failure path: shared counter
+        # + DeadlineError delivery via the AdmissionQueue contract
+        self._aq.expire(req, msg)
+
+    def _evict_request(self, req: _GenRequest) -> None:
+        for slot in range(self.max_slots):
+            if self._active[slot] and self._slot_req[slot] is not None \
+                    and self._slot_req[slot][0] is req:
+                self._active[slot] = False
+                self._slot_req[slot] = None
+                req.live_rows -= 1
+        if self._partial is req:
+            self._partial = None
+
+    def _abort_inflight_locked(self, exc: Exception) -> None:
+        seen = set()
+        for slot in range(self.max_slots):
+            entry = self._slot_req[slot]
+            if entry is not None and id(entry[0]) not in seen:
+                seen.add(id(entry[0]))
+                entry[0].fail(exc)
+            self._slot_req[slot] = None
+            self._active[slot] = False
+        if self._partial is not None:
+            if id(self._partial) not in seen:
+                self._partial.fail(exc)
+            self._partial = None
+
+    def _drain_queue(self, exc: Exception) -> None:
+        self._aq.drain(exc)
+
+    # -- accounting -----------------------------------------------------
+    def occupancy(self) -> float:
+        """Time-weighted slot occupancy since start (1.0 = every slot
+        busy every step — zero padding waste)."""
+        return (self._occupancy_steps / (self.steps_total * self.max_slots)
+                if self.steps_total else 0.0)
+
+    def stats(self) -> Dict[str, Any]:
+        return {
+            "max_slots": self.max_slots,
+            "active_slots": int(self._active.sum()),
+            "queue_depth": self._aq.depth(),
+            "occupancy": round(self.occupancy(), 4),
+            "steps_total": self.steps_total,
+            "admitted_total": self.admitted_total,
+            "retired_total": self.retired_total,
+            "tokens_total": self.tokens_total,
+            "dispatches_total": self.dispatches_total,
+            "syncs_total": self.syncs_total,
+            "compiles": self.compiles,
+            "beam_size": self.spec.beam_size,
+            "max_len": self.spec.max_len,
+        }
+
+
+def _finalize_slot(parents: np.ndarray, toks: np.ndarray,
+                   scores: np.ndarray, t_star: int, spec):
+    """Backtrack + finalize ONE retired slot, numpy mirror of
+    ops/beam_common.backtrack + finalize restricted to t* steps.
+
+    Bit-identity with batch-mode decode: past the step where every beam
+    finished, the batch kernel's expand/prune is the identity (frozen
+    beams emit EOS at zero cost, top_k keeps the already-descending
+    score order), so columns t* .. T-1 of its trellis backtrack to EOS
+    and the scores never change — padding with eos_id reproduces the
+    full-T result exactly. Integer gathers and the length-normalize
+    float32 division round identically in numpy and XLA."""
+    K = parents.shape[0]
+    T = spec.max_len
+    ids = np.full((K, T), spec.eos_id, np.int32)
+    idx = np.arange(K)
+    for t in range(t_star - 1, -1, -1):
+        ids[:, t] = toks[idx, t]
+        idx = parents[idx, t]
+    is_eos = ids == spec.eos_id
+    any_eos = is_eos.any(axis=-1)
+    first_eos = is_eos.argmax(axis=-1)
+    lengths = np.where(any_eos, first_eos + 1, T).astype(np.int32)
+    scores = scores.astype(np.float32)
+    if spec.length_normalize:
+        scores = scores / np.maximum(lengths, 1).astype(scores.dtype)
+        order = np.argsort(-scores, kind="stable")
+        scores = scores[order]
+        ids = ids[order]
+        lengths = lengths[order]
+    return ids, scores, lengths

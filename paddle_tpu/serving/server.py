@@ -13,17 +13,30 @@ Endpoints:
   POST /predict/<model>    multi-model registry routing
        body: {"inputs": {feed_name: nested list}, "timeout_ms": opt}
        reply: {"outputs": {fetch_name: nested list}, "model": name}
+  POST /generate           generation models: continuous-batching
+  POST /generate/<model>   decode (serving/scheduler.py). Body adds
+                           "stream": true for chunked NDJSON — one
+                           {"event": "token", ...} line per decoded
+                           step as the shared pool produces it, then a
+                           terminal {"event": "done", "outputs": ...}
+                           (or {"event": "error", ...}). Without
+                           "stream" the reply is one JSON object:
+                           {"model", "outputs": {ids, scores, lengths}}
   GET  /healthz            {"status": "ok", "models": [...]}
   GET  /stats              per-model engine/bucket/cache accounting
+                           (+ "generation" slot-pool stats)
   GET  /metrics            Prometheus text (latency histograms,
                            batch-size histogram, queue depth, cache
-                           hit/miss counters, shed/deadline counters)
+                           hit/miss counters, shed/deadline counters,
+                           slot occupancy + first/per-token latency)
 
 Status mapping: 400 malformed request, 404 unknown model/route,
-503 load shed (queue full) or circuit breaker open (both include
-Retry-After), 504 deadline exceeded, 500 engine failure. /healthz
-reports "degraded" plus per-model circuit state whenever any model's
-breaker is not closed.
+503 load shed (queue full), circuit breaker open, or generation pool
+aborted mid-step (all include Retry-After), 504 deadline exceeded,
+500 engine failure. /healthz reports "degraded" plus per-model circuit
+state whenever any model's breaker is not closed — the /predict and
+/generate paths of one model share ONE CircuitBreaker, so step
+failures in the decode pool trip the same circuit engine failures do.
 """
 
 from __future__ import annotations
@@ -61,6 +74,7 @@ class ModelRegistry:
         batcher: Optional[MicroBatcher] = None,
         policy: Optional[BucketPolicy] = None,
         breaker: Optional[CircuitBreaker] = None,
+        scheduler_kw: Optional[dict] = None,
         **batcher_kw,
     ) -> Tuple[ServingEngine, MicroBatcher]:
         if engine is None:
@@ -79,23 +93,44 @@ class ModelRegistry:
                 f"circuit_state_{_sanitize(name)}",
                 lambda b=batcher.breaker: STATE_CODES[b.state()],
                 help="circuit breaker state (0=closed 1=half_open 2=open)")
+        if engine.generation_spec() is not None:
+            # the /generate path: build the continuous scheduler up
+            # front sharing the /predict path's breaker — decode-pool
+            # step failures and engine failures trip ONE circuit, and
+            # /healthz's per-model state covers both
+            engine.scheduler(breaker=batcher.breaker,
+                             **(scheduler_kw or {}))
+        elif scheduler_kw:
+            raise ValueError(
+                f"model {name!r} is not a generation model; "
+                f"scheduler_kw {sorted(scheduler_kw)} has no effect")
         self._models[name] = (engine, batcher)
         return engine, batcher
 
     def get(self, name: str) -> Tuple[ServingEngine, MicroBatcher]:
         return self._models[name]
 
+    def scheduler(self, name: str):
+        """The model's ContinuousScheduler (started), or raises
+        ValueError for non-generation models."""
+        engine, _ = self._models[name]
+        return engine.scheduler()
+
     def names(self):
         return sorted(self._models)
 
     def start(self) -> "ModelRegistry":
-        for _, b in self._models.values():
+        for e, b in self._models.values():
             b.start()
+            if e._scheduler is not None:
+                e._scheduler.start()
         return self
 
     def stop(self) -> None:
-        for _, b in self._models.values():
+        for e, b in self._models.values():
             b.stop()
+            if e._scheduler is not None:
+                e._scheduler.stop()
 
     def stats(self) -> Dict[str, dict]:
         out = {}
@@ -161,27 +196,33 @@ class _Handler(BaseHTTPRequestHandler):
             self._error(404, f"no route {self.path!r}")
 
     def do_POST(self):
-        if self.path == "/predict":
-            name = "default"
-        elif self.path.startswith("/predict/"):
-            name = self.path[len("/predict/"):]
-        else:
-            self._error(404, f"no route {self.path!r}")
+        for route, handler in (("/predict", self._predict),
+                               ("/generate", self._generate)):
+            if self.path == route:
+                name = "default"
+            elif self.path.startswith(route + "/"):
+                name = self.path[len(route) + 1:]
+            else:
+                continue
+            reg = self.server.registry
+            try:
+                engine, batcher = reg.get(name)
+            except KeyError:
+                self._error(404,
+                            f"unknown model {name!r}; have {reg.names()}")
+                return
+            try:
+                length = int(self.headers.get("Content-Length", 0))
+                req = json.loads(self.rfile.read(length) or b"{}")
+                feed = engine.coerce_feed(req["inputs"])
+            except (ValueError, KeyError, TypeError) as e:
+                self._error(400, f"bad request: {e}")
+                return
+            handler(name, engine, batcher, feed, req)
             return
-        reg = self.server.registry
-        try:
-            engine, batcher = reg.get(name)
-        except KeyError:
-            self._error(404, f"unknown model {name!r}; have {reg.names()}")
-            return
-        try:
-            length = int(self.headers.get("Content-Length", 0))
-            req = json.loads(self.rfile.read(length) or b"{}")
-            inputs = req["inputs"]
-            feed = engine.coerce_feed(inputs)
-        except (ValueError, KeyError, TypeError) as e:
-            self._error(400, f"bad request: {e}")
-            return
+        self._error(404, f"no route {self.path!r}")
+
+    def _predict(self, name, engine, batcher, feed, req):
         try:
             outs = batcher.predict(
                 feed, timeout_ms=req.get("timeout_ms"))
@@ -201,6 +242,68 @@ class _Handler(BaseHTTPRequestHandler):
                 for fn, o in zip(engine.fetch_names, outs)
             },
         })
+
+    # -- generation (continuous batching) -------------------------------
+    @staticmethod
+    def _outputs_json(outputs):
+        return {k: np.asarray(v).tolist() for k, v in outputs.items()}
+
+    def _generate(self, name, engine, batcher, feed, req):
+        """POST /generate[/<model>]: token-level continuous batching.
+        "stream": true switches to chunked NDJSON — tokens flush as the
+        decode pool emits them, so first-token latency is one pool step
+        plus queue wait, not a full batch drain."""
+        if engine.generation_spec() is None:
+            self._error(400, f"model {name!r} is not a generation model "
+                             "(no beam_search_group op); use /predict")
+            return
+        try:
+            sched = engine.scheduler()
+        except ValueError as e:
+            self._error(400, str(e))
+            return
+        timeout_ms = req.get("timeout_ms")
+        if not req.get("stream"):
+            try:
+                outputs = sched.generate(feed, timeout_ms=timeout_ms)
+            except (ShedError, CircuitOpenError) as e:
+                # GenerationAborted is a ShedError: retryable 503
+                self._error(503, str(e))
+                return
+            except DeadlineError as e:
+                self._error(504, str(e))
+                return
+            except Exception as e:
+                self._error(500, f"{type(e).__name__}: {e}")
+                return
+            self._send(200, {"model": name,
+                             "outputs": self._outputs_json(outputs)})
+            return
+        # streaming: admission errors still map to clean HTTP statuses;
+        # once the stream is open, failures arrive as terminal
+        # {"event": "error"} lines (the status is already on the wire)
+        try:
+            handle = sched.submit(feed, timeout_ms=timeout_ms)
+        except (ShedError, CircuitOpenError) as e:
+            self._error(503, str(e))
+            return
+        self.send_response(200)
+        self.send_header("Content-Type", "application/x-ndjson")
+        self.send_header("Transfer-Encoding", "chunked")
+        self.end_headers()
+        try:
+            for ev in handle.events():
+                if ev["event"] == "done":
+                    ev = {"event": "done", "model": name,
+                          "outputs": self._outputs_json(ev["outputs"])}
+                self._write_chunk(json.dumps(ev).encode() + b"\n")
+            self._write_chunk(b"")  # terminal zero-length chunk
+        except (BrokenPipeError, ConnectionResetError):
+            pass  # client went away; the scheduler finishes the slot
+
+    def _write_chunk(self, data: bytes) -> None:
+        self.wfile.write(f"{len(data):x}\r\n".encode() + data + b"\r\n")
+        self.wfile.flush()
 
 
 class ServingServer(ThreadingHTTPServer):

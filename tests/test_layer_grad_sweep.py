@@ -891,6 +891,13 @@ EXEMPT = {
     "detection_output": "decode-only: NMS box selection, integer/threshold logic",
     "BeamSearchDecoder": "decode-only generation driver (no training loss)",
     "attention_gru_beam_search": "decode-only generation driver",
+    # the reusable decode-step surface (continuous-batching serving PR):
+    # inference-only plumbing re-exported through layers.generation
+    "GenSpec": "static op-description NamedTuple, not a computation",
+    "DecodeState": "decode-slot state pytree (inference-only carrier)",
+    "beam_step": "decode-only: one beam-search step over frozen weights",
+    "find_generation_op": "program introspection helper, no computation",
+    "gen_spec_from_op": "program introspection helper, no computation",
     "RawConvBN": "container type of the fused conv+BN protocol, not a "
                  "layer fn (its three producers/consumers have cases)",
     "prior_box": "constant anchor generation from static shapes",
