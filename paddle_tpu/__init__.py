@@ -23,6 +23,7 @@ from . import amp  # noqa: F401
 from .amp import amp_guard  # noqa: F401
 from . import flags  # noqa: F401
 from .flags import FLAGS, define_flag, parse_flags  # noqa: F401
+from . import obs  # noqa: F401
 from . import plot  # noqa: F401
 from . import profiler  # noqa: F401
 from . import core  # noqa: F401
@@ -68,11 +69,12 @@ from .version import full_version as __version__  # noqa: F401
 
 def reset():
     """Fresh default programs + scope + tune overrides + fault-injection
-    registry (test isolation helper)."""
+    registry + unified metrics registry (test isolation helper)."""
     reset_default_programs()
     reset_global_scope()
     tune.overrides.reset()
     resilience.faults.reset()
+    obs.metrics.registry().reset_metrics()
 
 
 def init(seed: int = 0, distributed: bool = False, **flag_overrides):

@@ -33,6 +33,14 @@ Commands:
                                       device executors only)
               --log_period N          print cost every N batches (reading
                                       the lazy cost is itself a sync)
+              observability (README "Observability"):
+              --trace_out PATH        arm span tracing; export a Chrome
+                                      trace-event JSON (Perfetto) at exit
+                                      (env PT_FLAGS_TRACE)
+              --stats_period N        log a runtime-stats line every N
+                                      steps (paddle_tpu.stats logger)
+              --dump_stats            print the unified metrics registry
+                                      + timer table at exit
   merge_model --model_dir D --out O   (MergeModel.cpp parity: checkpoint
                                        params -> single deployable dir)
   serve       --model_dir D [--model name=dir ...] [--host H] [--port P]
@@ -55,6 +63,12 @@ Commands:
               on any backend; real timing requires TPU).
               Kernels: bahdanau (B,S,A,C), flash (Tq,Tk), conv
               (n,cin,cout), lstm/gru (B,H).
+  stats       --url http://host:port | --file exposition.txt [--raw 1]
+              scrape (or read) a Prometheus /metrics exposition, parse
+              it with the paddle_tpu.obs.promparse grammar, and print a
+              per-family summary — the CLI view of the unified metrics
+              registry a serving process exposes and a training run
+              dumps at exit (--dump_stats)
   flags       print the flag registry
   version     print the version
 """
@@ -83,7 +97,7 @@ def _cmd_train(argv) -> int:
 
     from .trainer import CheckpointConfig, Trainer
 
-    train_opts = ("config", "num_passes", "save_dir")
+    train_opts = ("config", "num_passes", "save_dir", "trace_out")
     cfg = {}
     rest = []
     i = 0
@@ -129,7 +143,26 @@ def _cmd_train(argv) -> int:
         raise SystemExit("\n".join(msgs) + f"\n{flags_help()}")
     if "config" not in cfg:
         raise SystemExit("train requires --config <model.py>")
+    from .obs import trace as obs_trace
+
+    if cfg.get("trace_out"):
+        # arm before the model builds so warmup/compile spans are in the
+        # capture too; exported in the finally below (and idempotently
+        # by the atexit hook if the env flag armed it first)
+        obs_trace.arm(out=cfg["trace_out"])
     model = _load_config(cfg["config"])
+    if FLAGS.stats_period:
+        # the trainer emits the periodic runtime-stats line through the
+        # paddle_tpu.stats logger; a CLI run that asked for it must see
+        # it without configuring logging first
+        import logging
+
+        slog = logging.getLogger("paddle_tpu.stats")
+        if not slog.handlers:
+            h = logging.StreamHandler()
+            h.setFormatter(logging.Formatter("%(name)s: %(message)s"))
+            slog.addHandler(h)
+            slog.setLevel(logging.INFO)
     num_passes = int(cfg.get("num_passes", model.get("num_passes", 1)))
     # checkpointing (and its auto-resume) only when the user asks for it:
     # a default dir would make a rerun of a finished job silently resume
@@ -152,6 +185,23 @@ def _cmd_train(argv) -> int:
 
     from .resilience import PREEMPT_EXIT_CODE, PreemptedError
 
+    def finish():
+        # dump-at-exit observability: export the trace capture (if any)
+        # and print the same unified metrics surface a serving process
+        # exposes on /metrics
+        if obs_trace.armed():
+            tr = obs_trace.disarm(export=True)
+            out = getattr(tr, "out", None) if tr is not None else None
+            if out:
+                print(f"trace written to {out} ({tr.event_count()} "
+                      f"events, {tr.dropped_total()} dropped)", flush=True)
+        if FLAGS.dump_stats:
+            from . import profiler
+            from .obs import metrics as obs_metrics
+
+            profiler.global_stat_set().print_all_status()
+            print(obs_metrics.registry().render(), end="")
+
     try:
         metrics = trainer.train(
             model["reader"],
@@ -164,8 +214,10 @@ def _cmd_train(argv) -> int:
         # EX_TEMPFAIL: the scheduler should reschedule this job; a rerun
         # with the same --save_dir resumes from the emergency checkpoint
         print(f"preempted: {e}", flush=True)
+        finish()
         return PREEMPT_EXIT_CODE
     print("final:", {k: round(float(v), 6) for k, v in metrics.items()})
+    finish()
     return 0
 
 
@@ -245,8 +297,15 @@ def _cmd_serve(argv) -> int:
         "max_batch_size": str, "max_wait_ms": str, "max_queue": str,
         "timeout_ms": str, "seq_len_buckets": str, "warmup": str,
         "max_slots": str, "gen_queue": str, "gen_timeout_ms": str,
+        "trace_out": str,
     }
     opts = _parse_kv(argv, known)
+    if opts.get("trace_out"):
+        from .obs import trace as obs_trace
+
+        obs_trace.arm(out=opts["trace_out"])
+        print(f"span tracing armed; Chrome trace JSON will be written "
+              f"to {opts['trace_out']} at shutdown", flush=True)
     models = {}
     if "model_dir" in opts:
         models["default"] = opts["model_dir"]
@@ -304,6 +363,13 @@ def _cmd_serve(argv) -> int:
     finally:
         registry.stop()
         server.server_close()
+        from .obs import trace as obs_trace
+
+        if obs_trace.armed():
+            tr = obs_trace.disarm(export=True)
+            out = getattr(tr, "out", None) if tr is not None else None
+            if out:
+                print(f"trace written to {out}", flush=True)
     return 0
 
 
@@ -431,6 +497,57 @@ def _cmd_tune(argv) -> int:
     return 0
 
 
+def _cmd_stats(argv) -> int:
+    """Scrape/parse a Prometheus exposition and print a summary: the
+    consumer side of the unified metrics registry (obs.promparse is the
+    same parser the tier-1 smoke test validates the renderer with)."""
+    from .obs import promparse
+
+    known = {"url": str, "file": str, "raw": str}
+    opts = _parse_kv(argv, known)
+    if "url" in opts:
+        import urllib.request
+
+        url = opts["url"]
+        if not url.rstrip("/").endswith("/metrics"):
+            url = url.rstrip("/") + "/metrics"
+        with urllib.request.urlopen(url, timeout=10) as r:
+            text = r.read().decode()
+    elif "file" in opts:
+        with open(opts["file"]) as f:
+            text = f.read()
+    else:
+        raise SystemExit(
+            "stats requires --url http://host:port (a serving process's "
+            "/metrics) or --file <exposition.txt>")
+    try:
+        families = promparse.parse_text(text)
+    except promparse.ParseError as e:
+        raise SystemExit(f"exposition did not parse: {e}") from None
+    if opts.get("raw") in ("1", "true", "yes"):
+        print(text, end="")
+        return 0
+    print(f"{'family':<48}{'type':>10}{'series':>8}{'value':>14}")
+    for name in sorted(families):
+        f = families[name]
+        if f.type == "histogram":
+            count = sum(v for n, _, v in f.samples
+                        if n == f"{name}_count")
+            total = sum(v for n, _, v in f.samples if n == f"{name}_sum")
+            val = f"n={int(count)} sum={total:.4g}"
+        elif len(f.samples) == 1:
+            val = f"{f.samples[0][2]:.6g}"
+        else:
+            val = f"{len(f.samples)} series"
+        print(f"{name:<48}{f.type:>10}{len(f.samples):>8}{val:>14}")
+        if f.type not in ("histogram",) and 1 < len(f.samples) <= 8:
+            for sname, labels, v in f.samples:
+                lb = ",".join(f"{k}={x}" for k, x in sorted(labels.items()))
+                print(f"    {sname}{{{lb}}} {v:.6g}")
+    print(f"{len(families)} families parsed OK")
+    return 0
+
+
 def main(argv=None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     if not argv or argv[0] in ("-h", "--help", "help"):
@@ -445,6 +562,8 @@ def main(argv=None) -> int:
         return _cmd_serve(rest)
     if cmd == "tune":
         return _cmd_tune(rest)
+    if cmd == "stats":
+        return _cmd_stats(rest)
     if cmd == "flags":
         print(flags_help())
         return 0
@@ -454,7 +573,7 @@ def main(argv=None) -> int:
         print(full_version)
         return 0
     raise SystemExit(f"unknown command {cmd!r}; try: train, merge_model, "
-                     "serve, tune, flags, version")
+                     "serve, tune, stats, flags, version")
 
 
 if __name__ == "__main__":

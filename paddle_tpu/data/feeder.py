@@ -17,6 +17,7 @@ import numpy as np
 from ..core.lod import LoDArray
 from ..core.program import Variable
 from ..core.sparse import SparseArray
+from ..obs import trace as obs_trace
 
 
 class DataFeeder:
@@ -180,13 +181,24 @@ class DevicePrefetcher:
 
             buf, sig = [], None
             try:
-                for batch in self.reader():
+                for i, batch in enumerate(self.reader()):
                     if stop.is_set():
                         return
+                    # producer-thread span: the batch index here is the
+                    # SAME index the trainer's BeginIteration/step spans
+                    # carry, so prefetch→enqueue latency reads straight
+                    # off the exported timeline (disarmed: one bool test,
+                    # zero allocations — the obs lint enforces the guard)
+                    armed = obs_trace._armed
+                    if armed:
+                        obs_trace.set_context(batch=i)
+                        obs_trace._begin("prefetch.batch", "prefetch")
                     feed = self.feeder.feed(batch) if self.feeder else batch
                     feed = {
                         k: jax.tree.map(put, v) for k, v in feed.items()
                     }
+                    if armed:
+                        obs_trace._end()
                     if not self.window:
                         q.put(feed)
                         continue
@@ -194,12 +206,20 @@ class DevicePrefetcher:
                     if buf and s != sig:
                         # shape change mid-stream: flush the partial
                         # window so every window stays one compiled shape
+                        if armed:
+                            obs_trace._begin("prefetch.window", "prefetch")
                         q.put(_stack_feeds(buf))
+                        if armed:
+                            obs_trace._end()
                         buf = []
                     sig = s
                     buf.append(feed)
                     if len(buf) == self.window:
+                        if armed:
+                            obs_trace._begin("prefetch.window", "prefetch")
                         q.put(_stack_feeds(buf))
+                        if armed:
+                            obs_trace._end()
                         buf = []
                 if buf:  # ragged tail window at pass end
                     q.put(_stack_feeds(buf))

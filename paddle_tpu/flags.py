@@ -155,6 +155,16 @@ define_flag("save_dir", "./output",
             "conventional checkpoint directory; checkpointing itself is "
             "enabled per-run (CLI: train --save_dir; API: "
             "Trainer(checkpoint_config=...))")
+define_flag("stats_period", 0,
+            "trainer: emit a one-line runtime-stats log (step, "
+            "dispatches, syncs, checkpoint commits, guard skips, trace "
+            "drops — the paddle_tpu.stats logger) every N steps; the "
+            "training-side view of the unified metrics registry that "
+            "serving exposes on /metrics. 0 = off")
+define_flag("dump_stats", False,
+            "CLI train: print the unified metrics registry (Prometheus "
+            "text) and the global timer table at exit — the dump-at-exit "
+            "counterpart of scraping a serving process's /metrics")
 define_flag("enable_timers", False,
             "accumulate REGISTER_TIMER-style stat timers "
             "(reference: utils/Stat.h, WITH_TIMER)")

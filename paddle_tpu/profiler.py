@@ -25,16 +25,18 @@ from __future__ import annotations
 import collections
 import contextlib
 import statistics
+import threading
 import time
 from typing import Any, Dict, Optional
 
 import numpy as np
 
 from .flags import FLAGS
+from .obs import trace as _trace
 
 
 class Stat:
-    __slots__ = ("name", "count", "total", "max", "samples")
+    __slots__ = ("name", "count", "total", "max", "samples", "_lock")
 
     def __init__(self, name: str, keep_samples: int = 0):
         self.name = name
@@ -47,13 +49,17 @@ class Stat:
         self.samples = (
             collections.deque(maxlen=keep_samples) if keep_samples else None
         )
+        # serving thread pool + background checkpoint writer land in the
+        # same Stat concurrently; count/total updates must not tear
+        self._lock = threading.Lock()
 
     def add(self, dt: float) -> None:
-        self.count += 1
-        self.total += dt
-        self.max = max(self.max, dt)
-        if self.samples is not None:
-            self.samples.append(dt)
+        with self._lock:
+            self.count += 1
+            self.total += dt
+            self.max = max(self.max, dt)
+            if self.samples is not None:
+                self.samples.append(dt)
 
     @property
     def avg(self) -> float:
@@ -65,7 +71,8 @@ class Stat:
         sample retention is off (keep_samples=0)."""
         if not self.samples:
             return self.avg
-        return statistics.median(self.samples)
+        with self._lock:
+            return statistics.median(self.samples)
 
 
 class StatSet:
@@ -73,54 +80,86 @@ class StatSet:
 
     `keep_samples=k` makes every Stat retain its last k raw timings
     (deque ring) so `Stat.median` is exact — used by tune/harness.py's
-    median-of-k measurement loop."""
+    median-of-k measurement loop.
+
+    Thread-safe: `get` guards the dict insertion and `Stat.add` its own
+    accumulation — the serving HTTP threads, the batcher worker, and
+    the background checkpoint writer all hit one global set."""
 
     def __init__(self, keep_samples: int = 0):
         self.keep_samples = keep_samples
         self.stats: Dict[str, Stat] = {}
+        self._lock = threading.Lock()
 
     def get(self, name: str) -> Stat:
-        if name not in self.stats:
-            self.stats[name] = Stat(name, self.keep_samples)
-        return self.stats[name]
+        s = self.stats.get(name)
+        if s is None:
+            with self._lock:
+                s = self.stats.get(name)
+                if s is None:
+                    s = self.stats[name] = Stat(name, self.keep_samples)
+        return s
 
     @contextlib.contextmanager
     def timer(self, name: str, always: bool = False):
         """RAII timer (REGISTER_TIMER parity). No-op unless
-        FLAGS.enable_timers or always=True (WITH_TIMER compile gate)."""
-        if not (always or FLAGS.enable_timers):
+        FLAGS.enable_timers or always=True (WITH_TIMER compile gate) —
+        or span tracing is armed (obs.trace), in which case the block
+        additionally records a span on this thread's trace ring (the
+        timer vocabulary IS the span vocabulary)."""
+        traced = _trace._armed
+        if not (always or FLAGS.enable_timers or traced):
             yield
             return
+        if traced:
+            _trace._begin(name, "timer")
         t0 = time.perf_counter()
         try:
             yield
         finally:
-            self.get(name).add(time.perf_counter() - t0)
+            dt = time.perf_counter() - t0
+            if traced:
+                _trace._end()
+            if always or FLAGS.enable_timers:
+                self.get(name).add(dt)
 
     def print_all_status(self) -> str:
-        """Formatted table (reference: StatSet::printAllStatus)."""
-        rows = [f"{'name':<30}{'count':>8}{'total(s)':>12}{'avg(ms)':>10}{'max(ms)':>10}"]
+        """Formatted table (reference: StatSet::printAllStatus); adds a
+        median column when sample retention is on."""
+        med = bool(self.keep_samples)
+        header = (f"{'name':<30}{'count':>8}{'total(s)':>12}"
+                  f"{'avg(ms)':>10}{'max(ms)':>10}")
+        if med:
+            header += f"{'med(ms)':>10}"
+        rows = [header]
         for name in sorted(self.stats):
             s = self.stats[name]
-            rows.append(
-                f"{name:<30}{s.count:>8}{s.total:>12.4f}"
-                f"{s.avg * 1e3:>10.3f}{s.max * 1e3:>10.3f}"
-            )
+            row = (f"{name:<30}{s.count:>8}{s.total:>12.4f}"
+                   f"{s.avg * 1e3:>10.3f}{s.max * 1e3:>10.3f}")
+            if med:
+                row += f"{s.median * 1e3:>10.3f}"
+            rows.append(row)
         out = "\n".join(rows)
         print(out)
         return out
 
     def as_dict(self) -> Dict[str, Dict[str, float]]:
-        """Point-in-time snapshot for programmatic export (the serving
-        /metrics endpoint renders this in Prometheus text format)."""
-        return {
-            name: {"count": s.count, "total": s.total,
-                   "avg": s.avg, "max": s.max}
-            for name, s in self.stats.items()
-        }
+        """Point-in-time snapshot for programmatic export (the unified
+        metrics registry renders this in Prometheus text format);
+        includes "median" when sample retention is on (the tune
+        harness's median-of-k statistic, exported rather than private)."""
+        out = {}
+        for name, s in list(self.stats.items()):
+            d = {"count": s.count, "total": s.total,
+                 "avg": s.avg, "max": s.max}
+            if s.samples is not None:
+                d["median"] = s.median
+            out[name] = d
+        return out
 
     def reset(self) -> None:
-        self.stats.clear()
+        with self._lock:
+            self.stats.clear()
 
 
 _global_stats = StatSet()

@@ -20,8 +20,10 @@ bounded). Layers, bottom-up:
 - `server`  — ModelRegistry + threaded stdlib-HTTP JSON front-end
               (/predict, /generate incl. NDJSON streaming, /healthz,
               /stats, /metrics).
-- `metrics` — latency/batch/first-token histograms + Prometheus text
-              export over the existing profiler.StatSet plumbing.
+- `metrics` — latency/batch/first-token histograms as a namespaced
+              view over the process-wide paddle_tpu.obs.metrics
+              registry; /metrics renders the unified exposition
+              (serving + trainer + faults + timers in one scrape).
 
 CLI: `python -m paddle_tpu serve --model_dir <saved_inference_model>`.
 """

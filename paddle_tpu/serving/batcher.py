@@ -45,6 +45,7 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
+from ..obs import trace as obs_trace
 from ..resilience.breaker import CircuitBreaker, CircuitOpenError
 from .engine import ServingEngine
 from .metrics import MetricSet
@@ -89,6 +90,14 @@ class AdmissionQueue:
         self.metrics = metrics
         self.prefix = prefix
         self._q: collections.deque = collections.deque()
+        # pre-registered so scrapers see the series at 0, not appearing
+        # on the first shed/expiry
+        metrics.declare_counter(
+            f"{prefix}shed_total",
+            help="requests rejected because the queue was full")
+        metrics.declare_counter(
+            f"{prefix}deadline_exceeded_total",
+            help="requests that expired before their result")
 
     def __len__(self) -> int:
         with self.cond:
@@ -136,10 +145,12 @@ class AdmissionQueue:
 
 
 class _Request:
-    __slots__ = ("feed", "rows", "future", "deadline", "signature")
+    __slots__ = ("feed", "rows", "future", "deadline", "signature",
+                 "request_id")
 
     def __init__(self, feed: Dict[str, np.ndarray], deadline: float):
         self.feed = feed
+        self.request_id = obs_trace.new_request_id()
         rows = {v.shape[0] for v in feed.values() if v.ndim >= 1}
         if len(rows) != 1:
             raise ValueError(
@@ -184,6 +195,18 @@ class MicroBatcher:
         self.metrics.gauge(
             "queue_depth", lambda: len(self._q),
             help="requests waiting for dispatch")
+        self.metrics.declare_counter(
+            "requests_total", help="requests dispatched to the engine")
+        self.metrics.declare_counter(
+            "shed_total",
+            help="requests rejected because the queue was full")
+        self.metrics.declare_counter(
+            "deadline_exceeded_total",
+            help="requests that expired before dispatch")
+        self.metrics.declare_counter(
+            "circuit_open_total",
+            help="requests rejected because the model's circuit breaker "
+                 "was open")
 
     # -- lifecycle ------------------------------------------------------
     def start(self) -> "MicroBatcher":
@@ -334,6 +357,12 @@ class MicroBatcher:
                 self._cond.notify_all()  # wake stop(drain=True) waiters
 
     def _dispatch(self, batch: List[_Request]) -> None:
+        if obs_trace._armed:
+            # the coalesced call is the correlation point of the predict
+            # path: one span carrying every member request's id, on the
+            # batcher worker thread
+            obs_trace.set_context(
+                request_id=",".join(r.request_id for r in batch))
         try:
             if len(batch) == 1:
                 feed = batch[0].feed

@@ -185,6 +185,21 @@ class ServingEngine:
             "engine_run_seconds",
             help="end-to-end ServingEngine.predict latency (pad + XLA "
                  "run + slice)")
+        # pre-register every counter this engine can emit so a scraper
+        # never sees a missing series before the first request
+        self.metrics.declare_counter(
+            "compile_cache_hits_total",
+            help="requests served by an already-compiled bucket program")
+        self.metrics.declare_counter(
+            "compile_cache_misses_total",
+            help="requests that triggered a bucket compile")
+        self.metrics.declare_counter(
+            "dispatches_total",
+            help="XLA program dispatches issued by this engine")
+        self.metrics.declare_counter(
+            "syncs_total",
+            help="host d2h fences paid by this engine (numpy fetch "
+                 "per predict)")
 
     # ------------------------------------------------------------------
     def set_feed_specs(self, specs: Dict[str, Dict[str, Any]]) -> None:

@@ -1,14 +1,15 @@
-"""Serving metrics: histograms + gauges in Prometheus text format.
+"""Serving metrics: a namespaced view over the unified registry.
 
 Reference lineage: the Gen-1 runtime prints a StatSet table of named
 timers (paddle/utils/Stat.h:230 printAllStatus); a serving front-end
-needs the same accounting *scrapeable* — latency quantiles, batch-size
-distribution, queue depth, and compile-cache hit rate in the Prometheus
-text exposition format. This module builds on the existing
-`profiler.StatSet` plumbing (every serving timer also lands in the
-global stat table, so `print_all_status()` keeps working) and adds the
-two things StatSet lacks: bucketed histograms with quantile estimates
-and a point-in-time gauge/counter export.
+needs the same accounting *scrapeable*. Since ISSUE 8 the storage and
+the Prometheus renderer live in `paddle_tpu.obs.metrics` — ONE
+process-wide MetricsRegistry shared with the trainer's counters, the
+fault registry, the trace session, and the global StatSet — and this
+module is the serving-flavored view of it: a `MetricSet` prepends its
+namespace (`ptserving_` by default) to every family it registers, and
+`render()` returns the WHOLE unified exposition, so the HTTP `/metrics`
+endpoint scrapes training-side families too.
 
 Everything here is host-side and thread-safe (the HTTP front-end
 scrapes /metrics from a different thread than the batcher observes
@@ -17,19 +18,14 @@ from); no JAX in this module.
 
 from __future__ import annotations
 
-import threading
-from typing import Callable, Dict, List, Optional, Sequence, Tuple
+from typing import Any, Callable, Dict, Optional, Sequence
 
-from ..profiler import StatSet
+from ..obs import metrics as _obs_metrics
+from ..obs.metrics import (DEFAULT_LATENCY_BUCKETS, Histogram,  # noqa: F401
+                           _sanitize)
 
 __all__ = ["Histogram", "MetricSet", "DEFAULT_LATENCY_BUCKETS",
            "FIRST_TOKEN_BUCKETS", "TOKEN_INTERVAL_BUCKETS"]
-
-# seconds; spans sub-ms CPU fc models to multi-second cold compiles
-DEFAULT_LATENCY_BUCKETS = (
-    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
-    1.0, 2.5, 5.0, 10.0, 30.0,
-)
 
 # generation-serving latency grids (continuous batching): first-token
 # latency is queue wait + prefix run + one pool step (ms to seconds —
@@ -45,154 +41,64 @@ TOKEN_INTERVAL_BUCKETS = (
 )
 
 
-class Histogram:
-    """Cumulative-bucket histogram (Prometheus `histogram` type).
-
-    Quantiles are estimated from the bucket counts (each returns the
-    upper bound of the bucket containing the quantile — the standard
-    `histogram_quantile` resolution, good enough for p50/p95/p99
-    dashboards without keeping samples)."""
-
-    def __init__(self, name: str, buckets: Sequence[float],
-                 help: str = ""):
-        self.name = name
-        self.help = help
-        self.bounds: Tuple[float, ...] = tuple(sorted(buckets))
-        self.counts = [0] * (len(self.bounds) + 1)  # last = +Inf
-        self.sum = 0.0
-        self.count = 0
-        self._lock = threading.Lock()
-
-    def observe(self, value: float) -> None:
-        with self._lock:
-            self.sum += value
-            self.count += 1
-            for i, b in enumerate(self.bounds):
-                if value <= b:
-                    self.counts[i] += 1
-                    return
-            self.counts[-1] += 1
-
-    def percentile(self, q: float) -> float:
-        """Upper bound of the bucket holding quantile q in [0, 1];
-        0.0 when empty, the largest finite bound for the +Inf bucket."""
-        with self._lock:
-            if self.count == 0:
-                return 0.0
-            target = q * self.count
-            cum = 0
-            for i, b in enumerate(self.bounds):
-                cum += self.counts[i]
-                if cum >= target:
-                    return b
-            return self.bounds[-1] if self.bounds else 0.0
-
-    def render(self) -> List[str]:
-        lines = []
-        if self.help:
-            lines.append(f"# HELP {self.name} {self.help}")
-        lines.append(f"# TYPE {self.name} histogram")
-        with self._lock:
-            cum = 0
-            for i, b in enumerate(self.bounds):
-                cum += self.counts[i]
-                lines.append(f'{self.name}_bucket{{le="{_fmt(b)}"}} {cum}')
-            cum += self.counts[-1]
-            lines.append(f'{self.name}_bucket{{le="+Inf"}} {cum}')
-            lines.append(f"{self.name}_sum {_fmt(self.sum)}")
-            lines.append(f"{self.name}_count {self.count}")
-        return lines
-
-
-def _fmt(v: float) -> str:
-    # prometheus floats: integral values without the trailing .0 noise
-    return str(int(v)) if float(v).is_integer() else repr(float(v))
-
-
 class MetricSet:
-    """Registry of histograms, counters, and gauge callables with one
-    `render()` to the Prometheus text format.
+    """Namespaced registration view over the process-wide
+    MetricsRegistry (obs.metrics.registry()).
 
     Gauges are *callables* evaluated at scrape time (queue depth, cache
-    size): the instrumented component owns the value, the metric set
-    only knows how to read it — no double bookkeeping. A StatSet can be
-    attached; its timers render as `<name>_seconds_total` /
-    `<name>_count` counter pairs so the serving path's REGISTER_TIMER
-    accounting is scrapeable too."""
+    size): the instrumented component owns the value, the registry only
+    knows how to read it — no double bookkeeping. `stat_set` is kept
+    for API compatibility: the GLOBAL StatSet already rides the unified
+    render as `pt_timer_*`; a private StatSet passed here is attached
+    under this view's namespace."""
 
     def __init__(self, namespace: str = "ptserving",
-                 stat_set: Optional[StatSet] = None):
+                 stat_set=None,
+                 registry: Optional[_obs_metrics.MetricsRegistry] = None):
         self.namespace = namespace
+        self.registry = registry if registry is not None \
+            else _obs_metrics.registry()
         self.stat_set = stat_set
-        self._histograms: Dict[str, Histogram] = {}
-        self._counters: Dict[str, float] = {}
-        self._counter_help: Dict[str, str] = {}
-        self._gauges: Dict[str, Tuple[Callable[[], float], str]] = {}
-        self._lock = threading.Lock()
+        if stat_set is not None and not _is_global_stat_set(stat_set):
+            self.registry.attach_stat_set(
+                stat_set, prefix=f"{namespace}_timer_")
+
+    def _full(self, name: str) -> str:
+        return f"{self.namespace}_{name}"
 
     # -- registration ---------------------------------------------------
     def histogram(self, name: str,
                   buckets: Sequence[float] = DEFAULT_LATENCY_BUCKETS,
                   help: str = "") -> Histogram:
-        full = f"{self.namespace}_{name}"
-        with self._lock:
-            h = self._histograms.get(full)
-            if h is None:
-                h = self._histograms[full] = Histogram(full, buckets, help)
-            return h
+        return self.registry.histogram(self._full(name), buckets, help)
 
-    def counter_inc(self, name: str, by: float = 1.0,
-                    help: str = "") -> None:
-        full = f"{self.namespace}_{name}"
-        with self._lock:
-            self._counters[full] = self._counters.get(full, 0.0) + by
-            if help:
-                self._counter_help.setdefault(full, help)
+    def declare_counter(self, name: str, help: str = "",
+                        labels: Optional[Dict[str, Any]] = None) -> None:
+        """Pre-register the series at 0 (component constructors call
+        this so a scraper never sees the family appear mid-flight)."""
+        self.registry.declare_counter(self._full(name), help, labels)
 
-    def counter_value(self, name: str) -> float:
-        with self._lock:
-            return self._counters.get(f"{self.namespace}_{name}", 0.0)
+    def counter_inc(self, name: str, by: float = 1.0, help: str = "",
+                    labels: Optional[Dict[str, Any]] = None) -> None:
+        self.registry.counter_inc(self._full(name), by, help, labels)
+
+    def counter_value(self, name: str,
+                      labels: Optional[Dict[str, Any]] = None) -> float:
+        return self.registry.counter_value(self._full(name), labels)
 
     def gauge(self, name: str, fn: Callable[[], float],
               help: str = "") -> None:
-        with self._lock:
-            self._gauges[f"{self.namespace}_{name}"] = (fn, help)
+        self.registry.gauge(self._full(name), fn, help)
 
     # -- export ---------------------------------------------------------
     def render(self) -> str:
-        lines: List[str] = []
-        with self._lock:
-            hists = list(self._histograms.values())
-            counters = sorted(self._counters.items())
-            helps = dict(self._counter_help)
-            gauges = sorted(self._gauges.items())
-        for h in hists:
-            lines.extend(h.render())
-            # convenience quantile gauges so dashboards don't need
-            # histogram_quantile(); same data, pre-reduced
-            for q, label in ((0.5, "p50"), (0.95, "p95"), (0.99, "p99")):
-                lines.append(f"{h.name}_{label} {_fmt(h.percentile(q))}")
-        for name, v in counters:
-            if name in helps:
-                lines.append(f"# HELP {name} {helps[name]}")
-            lines.append(f"# TYPE {name} counter")
-            lines.append(f"{name} {_fmt(v)}")
-        for name, (fn, help) in gauges:
-            if help:
-                lines.append(f"# HELP {name} {help}")
-            lines.append(f"# TYPE {name} gauge")
-            try:
-                lines.append(f"{name} {_fmt(float(fn()))}")
-            except Exception:
-                lines.append(f"{name} NaN")
-        if self.stat_set is not None:
-            for name, s in sorted(self.stat_set.as_dict().items()):
-                metric = f"{self.namespace}_timer_{_sanitize(name)}"
-                lines.append(f"# TYPE {metric}_seconds_total counter")
-                lines.append(f"{metric}_seconds_total {_fmt(s['total'])}")
-                lines.append(f"{metric}_count {s['count']}")
-        return "\n".join(lines) + "\n"
+        """The UNIFIED exposition — every family in the process-wide
+        registry, not just this namespace (serving /metrics is a view
+        of the whole runtime; ISSUE 8)."""
+        return self.registry.render()
 
 
-def _sanitize(name: str) -> str:
-    return "".join(c if c.isalnum() or c == "_" else "_" for c in name)
+def _is_global_stat_set(stat_set) -> bool:
+    from .. import profiler
+
+    return stat_set is profiler.global_stat_set()

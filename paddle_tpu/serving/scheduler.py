@@ -49,6 +49,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from ..obs import trace as obs_trace
 from ..resilience import faults
 from ..resilience.breaker import CircuitBreaker, CircuitOpenError
 from .batcher import AdmissionQueue, DeadlineError, ShedError
@@ -76,6 +77,7 @@ class GenHandle:
 
     def __init__(self, rows: int):
         self.rows = rows
+        self.request_id: Optional[str] = None  # set by _GenRequest
         self._q: "queue.Queue[dict]" = queue.Queue()
         self._done = threading.Event()
         self._outputs: Optional[Dict[str, np.ndarray]] = None
@@ -119,12 +121,19 @@ class GenHandle:
 class _GenRequest:
     __slots__ = ("feed", "rows", "handle", "deadline", "submitted_at",
                  "first_token_at", "last_token_at", "boots", "pes",
-                 "next_row", "live_rows", "results", "failed")
+                 "next_row", "live_rows", "results", "failed",
+                 "request_id")
 
     def __init__(self, feed, rows: int, deadline: float):
         self.feed = feed
         self.rows = rows
+        # correlation key: every span this request touches — enqueue on
+        # the client thread, admit/prefix/first-token/retire on the
+        # scheduler worker, the HTTP span on the handler thread —
+        # carries this id (ISSUE 8 queue→admit→pool-step→stream flow)
+        self.request_id = obs_trace.new_request_id("gen")
         self.handle = GenHandle(rows)
+        self.handle.request_id = self.request_id
         self.deadline = deadline
         self.submitted_at = time.monotonic()
         self.first_token_at: Optional[float] = None
@@ -240,6 +249,19 @@ class ContinuousScheduler:
         self.metrics.gauge(
             "gen_queue_depth", lambda: self._aq.depth(),
             help="generation requests waiting for a slot")
+        # pre-registered counters: the scrape surface is complete from
+        # construction, not dependent on traffic having arrived
+        self.metrics.declare_counter(
+            "gen_requests_total", help="generation requests accepted")
+        self.metrics.declare_counter(
+            "gen_steps_total", help="decode pool steps executed")
+        self.metrics.declare_counter(
+            "gen_tokens_total",
+            help="tokens streamed across all generation requests")
+        self.metrics.declare_counter(
+            "circuit_open_total",
+            help="requests rejected because the model's circuit "
+                 "breaker was open")
 
     def _check_step_closures(self, program) -> None:
         """The pool-step env holds parameters and declared per-example
@@ -326,6 +348,11 @@ class ContinuousScheduler:
             if self._stopping:
                 raise ShedError("scheduler stopped")
         self._aq.put(req)  # sheds with ShedError/503 when full
+        if obs_trace._armed:
+            # enqueue marker on the CLIENT thread; the worker-side admit
+            # span carries the same request_id, linking the hand-off
+            obs_trace.instant("gen.enqueue", cat="gen",
+                              request_id=req.request_id, rows=n)
         self.metrics.counter_inc(
             "gen_requests_total", help="generation requests accepted")
         return req.handle
@@ -549,13 +576,15 @@ class ContinuousScheduler:
                     free = self._free_slots()
                     continue
             admitted_any = False
-            while free and req.next_row < req.rows:
-                slot = free.pop(0)
-                row = req.next_row
-                self._admit_row(req, row, slot)
-                req.next_row += 1
-                req.live_rows += 1
-                admitted_any = True
+            with obs_trace.span("gen.admit", cat="gen",
+                                request_id=req.request_id):
+                while free and req.next_row < req.rows:
+                    slot = free.pop(0)
+                    row = req.next_row
+                    self._admit_row(req, row, slot)
+                    req.next_row += 1
+                    req.live_rows += 1
+                    admitted_any = True
             self._partial = req if req.next_row < req.rows else None
             # deadline RE-CHECK after slot admission: the prefix run (a
             # possible cold bucket compile) may have eaten the budget —
@@ -571,12 +600,14 @@ class ContinuousScheduler:
                 return  # head-of-line request still owns the next slots
 
     def _run_prefix(self, req: _GenRequest) -> None:
-        padded, n, _ = self.engine._pad_feed(
-            {k: np.asarray(v) for k, v in req.feed.items()})
-        jnp = self._jax.numpy
-        padded = {k: jnp.asarray(v) for k, v in padded.items()}
-        fn = self._build_prefix(padded)
-        boots, pes = fn(self._params, padded)
+        with obs_trace.span("gen.prefix", cat="gen",
+                            request_id=req.request_id, rows=req.rows):
+            padded, n, _ = self.engine._pad_feed(
+                {k: np.asarray(v) for k, v in req.feed.items()})
+            jnp = self._jax.numpy
+            padded = {k: jnp.asarray(v) for k, v in padded.items()}
+            fn = self._build_prefix(padded)
+            boots, pes = fn(self._params, padded)
         mem_specs = tuple((tuple(b.shape[1:]), np.dtype(b.dtype))
                           for b in boots)
         pe_specs = tuple((tuple(p.shape[1:]), np.dtype(p.dtype))
@@ -598,6 +629,12 @@ class ContinuousScheduler:
 
     def _step_once(self) -> None:
         jnp = self._jax.numpy
+        armed = obs_trace._armed  # hot per-token path: guard all trace work
+        if armed:
+            obs_trace._begin("gen.pool_step", "gen",
+                             {"step": self.steps_total,
+                              "active": int(self._active.sum())})
+            obs_trace.counter("gen_active_slots", int(self._active.sum()))
         try:
             # the same chaos point engine.predict fires: a generation
             # step failure must fan out, feed the breaker, and free the
@@ -612,6 +649,8 @@ class ContinuousScheduler:
             tok, fin, stp = self._jax.device_get(
                 (self._state.tok, self._state.fin, self._state.step))
         except Exception as e:
+            if armed:
+                obs_trace._end()
             if self.breaker is not None:
                 self.breaker.record_failure()
             with self._cond:
@@ -620,6 +659,8 @@ class ContinuousScheduler:
                     f"({type(e).__name__}: {e}); in-flight requests "
                     "aborted, slots recovered — retry"))
             return
+        if armed:
+            obs_trace._end()
         self.dispatches_total += 1
         self.syncs_total += 1
         self.steps_total += 1
@@ -643,6 +684,10 @@ class ContinuousScheduler:
             if req.first_token_at is None:
                 req.first_token_at = now
                 self._first_tok.observe(now - req.submitted_at)
+                if armed:
+                    obs_trace.instant(
+                        "gen.first_token", cat="gen",
+                        request_id=req.request_id, slot=slot)
             if req.last_token_at is not None:
                 self._per_tok.observe(now - req.last_token_at)
             req.last_token_at = now
@@ -659,11 +704,14 @@ class ContinuousScheduler:
         """Early-exit compaction: backtrack THIS slot's trellis over its
         own t* steps, deliver, and free the slot immediately — the rest
         of the pool keeps decoding."""
-        parents = np.asarray(self._state.parents[slot])  # [K, T]
-        toks = np.asarray(self._state.trellis_tok[slot])
-        scores = np.asarray(self._state.scores[slot])
-        ids, out_scores, lengths = _finalize_slot(
-            parents, toks, scores, t_star, self.spec)
+        with obs_trace.span("gen.retire", cat="gen",
+                            request_id=req.request_id, slot=slot,
+                            steps=t_star):
+            parents = np.asarray(self._state.parents[slot])  # [K, T]
+            toks = np.asarray(self._state.trellis_tok[slot])
+            scores = np.asarray(self._state.scores[slot])
+            ids, out_scores, lengths = _finalize_slot(
+                parents, toks, scores, t_star, self.spec)
         req.results[row] = (ids, out_scores, lengths)
         self._active[slot] = False
         self._slot_req[slot] = None

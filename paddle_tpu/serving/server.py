@@ -49,6 +49,7 @@ from typing import Dict, Optional, Tuple
 import numpy as np
 
 from .. import profiler
+from ..obs import trace as obs_trace
 from ..resilience.breaker import STATE_CODES, CircuitBreaker, CircuitOpenError
 from .batcher import DeadlineError, MicroBatcher, ShedError
 from .engine import BucketPolicy, ServingEngine
@@ -224,8 +225,9 @@ class _Handler(BaseHTTPRequestHandler):
 
     def _predict(self, name, engine, batcher, feed, req):
         try:
-            outs = batcher.predict(
-                feed, timeout_ms=req.get("timeout_ms"))
+            with obs_trace.span("http.predict", cat="http", model=name):
+                outs = batcher.predict(
+                    feed, timeout_ms=req.get("timeout_ms"))
         except (ShedError, CircuitOpenError) as e:
             self._error(503, str(e))
             return
@@ -265,7 +267,9 @@ class _Handler(BaseHTTPRequestHandler):
         timeout_ms = req.get("timeout_ms")
         if not req.get("stream"):
             try:
-                outputs = sched.generate(feed, timeout_ms=timeout_ms)
+                with obs_trace.span("http.generate", cat="http",
+                                    model=name):
+                    outputs = sched.generate(feed, timeout_ms=timeout_ms)
             except (ShedError, CircuitOpenError) as e:
                 # GenerationAborted is a ShedError: retryable 503
                 self._error(503, str(e))
@@ -292,12 +296,18 @@ class _Handler(BaseHTTPRequestHandler):
         self.send_header("Transfer-Encoding", "chunked")
         self.end_headers()
         try:
-            for ev in handle.events():
-                if ev["event"] == "done":
-                    ev = {"event": "done", "model": name,
-                          "outputs": self._outputs_json(ev["outputs"])}
-                self._write_chunk(json.dumps(ev).encode() + b"\n")
-            self._write_chunk(b"")  # terminal zero-length chunk
+            # the stream span lives on the HTTP handler thread and
+            # carries the scheduler-assigned request_id — the last hop
+            # of the queue→admit→pool-step→stream correlation chain
+            with obs_trace.span("http.generate_stream", cat="http",
+                                model=name,
+                                request_id=handle.request_id):
+                for ev in handle.events():
+                    if ev["event"] == "done":
+                        ev = {"event": "done", "model": name,
+                              "outputs": self._outputs_json(ev["outputs"])}
+                    self._write_chunk(json.dumps(ev).encode() + b"\n")
+                self._write_chunk(b"")  # terminal zero-length chunk
         except (BrokenPipeError, ConnectionResetError):
             pass  # client went away; the scheduler finishes the slot
 

@@ -37,6 +37,7 @@ import queue
 import signal
 import threading
 import time
+import weakref
 from functools import partial
 from typing import Any, Callable, Dict, Optional, Sequence
 
@@ -46,6 +47,8 @@ import numpy as np
 
 from . import io
 from . import profiler
+from .obs import metrics as obs_metrics
+from .obs import trace as obs_trace
 from .core.executor import Executor, Scope, accum_fold, global_scope
 from .flags import FLAGS
 from .core.place import Place
@@ -340,13 +343,19 @@ class _CheckpointWriter:
         self._idle.set()
         self._exc: Optional[BaseException] = None
         self._thread: Optional[threading.Thread] = None
+        # commit accounting for the unified metrics registry
+        # (pt_ckpt_commits_total / pt_ckpt_failures_total gauges)
+        self.commits = 0
+        self.failures = 0
 
     def _loop(self):
         while True:
             fn = self._q.get()
             try:
                 fn()
+                self.commits += 1
             except BaseException as e:  # surfaced on the training thread
+                self.failures += 1
                 self._exc = e
             finally:
                 self._idle.set()
@@ -357,6 +366,17 @@ class _CheckpointWriter:
                 target=self._loop, daemon=True, name="ptpu-ckpt-writer")
             self._thread.start()
         self.drain()  # block only if the previous commit is in flight
+        if obs_trace._armed:
+            # hand the submitting thread's correlation ids (step/window)
+            # across to the writer thread: the commit span then links to
+            # the step that snapshotted it in the exported timeline
+            ctx = obs_trace.get_context()
+            inner = fn
+
+            def fn():
+                obs_trace.set_context(**ctx)
+                with obs_trace.span("checkpointCommit", cat="ckpt"):
+                    inner()
         self._idle.clear()
         self._q.put(fn)
 
@@ -447,6 +467,65 @@ class Trainer:
         # THIS unit: K fused steps = 1 dispatch (bench train_loop asserts
         # scan <= async dispatches; PERF.md 'Breaking the dispatch floor')
         self.host_dispatch_count = 0
+        self._register_obs_gauges()
+
+    def _register_obs_gauges(self) -> None:
+        """Publish the trainer's counter surface into the unified
+        metrics registry (ISSUE 8): the SAME numbers bench and the A/B
+        tests assert on become scrapeable/loggable. Registered through a
+        weakref so a dead trainer's series disappears instead of pinning
+        the object; a newer trainer takes the names over."""
+        reg = obs_metrics.registry()
+        ref = weakref.ref(self)
+
+        def read(fn):
+            def _get():
+                t = ref()
+                return None if t is None else float(fn(t))
+            return _get
+
+        reg.gauge("pt_trainer_step", read(lambda t: t.step),
+                  help="global step counter of the live trainer")
+        reg.gauge("pt_trainer_dispatches_total",
+                  read(lambda t: t.host_dispatch_count),
+                  help="XLA program dispatches issued by the step loop")
+        reg.gauge("pt_trainer_syncs_total",
+                  read(lambda t: t.host_sync_count),
+                  help="host d2h fences paid by the step loop")
+        reg.gauge("pt_ckpt_commits_total",
+                  read(lambda t: t._ckpt_writer.commits),
+                  help="background checkpoint commits completed")
+        reg.gauge("pt_ckpt_failures_total",
+                  read(lambda t: t._ckpt_writer.failures),
+                  help="background checkpoint commits that failed")
+        reg.gauge("pt_guard_skipped_total",
+                  read(lambda t: t.step_guard.skipped
+                       if t.step_guard else 0),
+                  help="non-finite steps skipped by the StepGuard")
+        reg.gauge("pt_guard_rollbacks_total",
+                  read(lambda t: t.step_guard.rollbacks
+                       if t.step_guard else 0),
+                  help="StepGuard checkpoint rollbacks performed")
+
+    # -- periodic stats line (ISSUE 8: training runs get the same
+    # observability surface serving scrapes) ------------------------------
+    def _log_stats(self) -> None:
+        g = self.step_guard.stats() if self.step_guard is not None else {}
+        logging.getLogger("paddle_tpu.stats").info(
+            "step=%d dispatches=%d syncs=%d ckpt_commits=%d "
+            "ckpt_failures=%d guard_skipped=%d guard_rollbacks=%d "
+            "trace_dropped=%d",
+            self.step, self.host_dispatch_count, self.host_sync_count,
+            self._ckpt_writer.commits, self._ckpt_writer.failures,
+            g.get("skipped", 0), g.get("rollbacks", 0),
+            obs_trace.dropped_total())
+
+    def _maybe_log_stats(self, k: int = 1) -> None:
+        """Emit the stats line when the last k steps crossed a multiple
+        of FLAGS.stats_period (host-side ints only — no device sync)."""
+        sp = FLAGS.stats_period
+        if sp and (self.step // sp) > ((self.step - k) // sp):
+            self._log_stats()
 
     # uniform counter surface: bench, the A/B tests, and the serving
     # layer's /stats read dispatch/sync totals under the same names
@@ -730,6 +809,14 @@ class Trainer:
             last_batch_id = batch_id
             if batch_id < skip_until:
                 continue
+            self._maybe_log_stats()
+            if obs_trace._armed:
+                # correlation ids for every span this step records —
+                # prepareBatchData/forwardBackward/hostSync timers and
+                # the checkpoint snapshot/commit all carry them; the
+                # prefetcher producer thread tags the same batch index
+                obs_trace.set_context(pass_id=pass_id, batch=batch_id,
+                                      step=self.step + 1)
             handler(BeginIteration(pass_id, batch_id))
             with profiler.timer("prepareBatchData"):
                 if prefetch_to_device:
@@ -891,6 +978,14 @@ class Trainer:
             k = win.k
             bids = list(range(next_batch, next_batch + k))
             next_batch += k
+            self._maybe_log_stats(k)
+            if obs_trace._armed:
+                # window-granular correlation: the forwardBackward span
+                # is ONE dispatch covering steps step+1..step+k; hostSync
+                # and checkpointCommit spans inherit the same window id
+                obs_trace.set_context(pass_id=pass_id, window=bids[0],
+                                      batch=bids[0], step=self.step + 1,
+                                      k=k)
             for b in bids:
                 handler(BeginIteration(pass_id, b))
             feed = win.feed
