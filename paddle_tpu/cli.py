@@ -47,11 +47,28 @@ Commands:
               [--max_batch_size N] [--max_wait_ms M] [--max_queue Q]
               [--timeout_ms T] [--seq_len_buckets 64,128,...] [--warmup 0|1]
               [--max_slots S] [--gen_queue Q] [--gen_timeout_ms T]
+              [--mesh dp1,mp2] [--drain_s S]
+              [--replicas N [--standby K] [--probe_interval_ms P]]
               batching HTTP inference server over saved inference
               models (paddle_tpu.serving): /predict, /healthz, /metrics
               — generation models additionally serve /generate
               (continuous batching over S decode slots, NDJSON
-              streaming with "stream": true)
+              streaming with "stream": true).
+              --mesh runs the replica sharded over a device mesh (the
+              artifact's sharding sidecar places params; README
+              "Scale-out serving"); SIGTERM drains in-flight work for
+              up to --drain_s seconds before exit.
+              --replicas N turns this process into a ROUTER that
+              pre-forks N replica serve processes (plus --standby
+              warmed spares), join-shortest-queue balances /predict
+              and /generate over them (streaming passes through),
+              retries shed/503s on another replica, circuit-breaks and
+              replaces dead replicas (paddle_tpu.serving.router)
+  route       --replica http://host:port [--replica ...] [--host H]
+              [--port P] [--probe_interval_ms P] [--request_timeout_ms T]
+              stand-alone router over ALREADY-RUNNING replica servers
+              (the cross-host deployment: one route process in front
+              of serve processes on other machines)
   tune        --kernel K --shape k=v,k=v [--shape ...] [--dtype bf16|f32]
               [--dry-run] [--cache PATH] [--iters N] [--warmup N]
               | --config M.py [--dry-run ...]
@@ -288,18 +305,31 @@ def _model_is_generative(model_dir: str) -> bool:
         return False
 
 
+_SERVE_KNOWN = {
+    "model_dir": str, "model": list, "host": str, "port": str,
+    "max_batch_size": str, "max_wait_ms": str, "max_queue": str,
+    "timeout_ms": str, "seq_len_buckets": str, "warmup": str,
+    "max_slots": str, "gen_queue": str, "gen_timeout_ms": str,
+    "trace_out": str, "mesh": str, "drain_s": str,
+    # fleet mode (router + replica processes); NOT forwarded to the
+    # replica children
+    "replicas": str, "standby": str, "probe_interval_ms": str,
+}
+_FLEET_ONLY = ("replicas", "standby", "probe_interval_ms", "host",
+               "port", "trace_out")
+
+
 def _cmd_serve(argv) -> int:
-    """Batching inference server over saved inference models."""
+    """Batching inference server over saved inference models. With
+    --replicas N this process becomes a ROUTER: it pre-forks N replica
+    serve processes (plus --standby warm spares), load-balances
+    /predict and /generate across them join-shortest-queue, and
+    fails over on replica death (serving/router.py)."""
     from .serving import BucketPolicy, ModelRegistry, make_server
 
-    known = {
-        "model_dir": str, "model": list, "host": str, "port": str,
-        "max_batch_size": str, "max_wait_ms": str, "max_queue": str,
-        "timeout_ms": str, "seq_len_buckets": str, "warmup": str,
-        "max_slots": str, "gen_queue": str, "gen_timeout_ms": str,
-        "trace_out": str,
-    }
-    opts = _parse_kv(argv, known)
+    opts = _parse_kv(argv, _SERVE_KNOWN)
+    if int(opts.get("replicas", 0) or 0) > 0:
+        return _serve_fleet(opts)
     if opts.get("trace_out"):
         from .obs import trace as obs_trace
 
@@ -318,6 +348,14 @@ def _cmd_serve(argv) -> int:
     if not models:
         raise SystemExit("serve requires --model_dir <dir> or at least "
                          "one --model name=dir")
+    mesh = None
+    if opts.get("mesh"):
+        # mesh-sharded replica: ONE model served across chips — params
+        # carrying the artifact's sharding sidecar land sharded, the
+        # HTTP surface is unchanged (README "Scale-out serving")
+        from .parallel.mesh import mesh_from_spec
+
+        mesh = mesh_from_spec(opts["mesh"])
     policy = BucketPolicy(
         max_batch_size=int(opts.get("max_batch_size", 64)),
         seq_len_buckets=tuple(
@@ -334,7 +372,7 @@ def _cmd_serve(argv) -> int:
     registry = ModelRegistry()
     for name, d in models.items():
         engine, _ = registry.add(
-            name, model_dir=d, policy=policy,
+            name, model_dir=d, policy=policy, mesh=mesh,
             max_wait_ms=float(opts.get("max_wait_ms", 5.0)),
             max_queue=int(opts.get("max_queue", 256)),
             timeout_ms=float(opts.get("timeout_ms", 2000.0)),
@@ -354,6 +392,25 @@ def _cmd_serve(argv) -> int:
     server = make_server(registry, host=opts.get("host", "127.0.0.1"),
                          port=int(opts.get("port", 8866)))
     registry.start()
+    # SIGTERM = graceful shutdown (the replica half of the router's
+    # failover contract, mirroring the trainer's preemption drain):
+    # stop accepting, then DRAIN in-flight work — queued predicts and
+    # running generation streams finish (bounded by --drain_s) before
+    # the process exits, so a router-managed replica being descheduled
+    # never tears a client's stream mid-token.
+    import signal
+    import threading
+
+    term = {"signaled": False}
+
+    def _on_term(signum, frame):
+        term["signaled"] = True
+        threading.Thread(target=server.shutdown, daemon=True).start()
+
+    try:
+        signal.signal(signal.SIGTERM, _on_term)
+    except ValueError:
+        pass  # not the main thread (embedded use): caller owns signals
     print(f"serving {registry.names()} on "
           f"http://{server.server_address[0]}:{server.port}", flush=True)
     try:
@@ -361,7 +418,20 @@ def _cmd_serve(argv) -> int:
     except KeyboardInterrupt:
         pass
     finally:
-        registry.stop()
+        drain_s = (float(opts.get("drain_s", 30.0))
+                   if term["signaled"] else 0.0)
+        if drain_s:
+            print(f"SIGTERM: draining in-flight work "
+                  f"(up to {drain_s:g}s)", flush=True)
+        registry.stop(drain_s=drain_s)
+        if drain_s:
+            # the scheduler/batcher have delivered every result; give
+            # in-flight (daemon) handler threads a beat to flush their
+            # final chunks down the socket before the interpreter exits
+            import time as _time
+
+            _time.sleep(0.5)
+            print("drained; exiting", flush=True)
         server.server_close()
         from .obs import trace as obs_trace
 
@@ -370,6 +440,98 @@ def _cmd_serve(argv) -> int:
             out = getattr(tr, "out", None) if tr is not None else None
             if out:
                 print(f"trace written to {out}", flush=True)
+    return 0
+
+
+def _serve_fleet(opts) -> int:
+    """serve --replicas N: router + pre-forked replica fleet."""
+    from .serving.router import Fleet, Router, make_router_server, \
+        replica_spawner
+
+    # child argv = every serving option EXCEPT the fleet-only ones;
+    # children bind port 0 on loopback and print their URL
+    if not opts.get("model_dir") and not opts.get("model"):
+        raise SystemExit("serve requires --model_dir <dir> or at "
+                         "least one --model name=dir")
+    child_args = []
+    for k, v in opts.items():
+        if k in _FLEET_ONLY:
+            continue
+        if isinstance(v, list):
+            child_args.extend(f"--{k}={x}" for x in v)
+        else:
+            child_args.append(f"--{k}={v}")
+    n = int(opts["replicas"])
+    standby = int(opts.get("standby", 0))
+    router = Router(
+        probe_interval_s=float(opts.get("probe_interval_ms", 500)) / 1e3)
+    fleet = Fleet(replica_spawner(child_args), replicas=n,
+                  standby=standby, router=router)
+    print(f"spawning {n} replica(s)"
+          + (f" + {standby} warm standby" if standby else "")
+          + " ...", flush=True)
+    fleet.start()
+    for r in router.replicas():
+        print(f"  replica {r.name}: {r.url}", flush=True)
+    server = make_router_server(
+        router, host=opts.get("host", "127.0.0.1"),
+        port=int(opts.get("port", 8866)))
+    server.serve_background()
+
+    import signal
+    import threading
+
+    stop = threading.Event()
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        try:
+            signal.signal(sig, lambda s, f: stop.set())
+        except ValueError:
+            pass
+    print(f"routing /predict and /generate for {n} replica(s) on "
+          f"http://{server.server_address[0]}:{server.port}", flush=True)
+    try:
+        while not stop.wait(0.5):
+            pass
+    except KeyboardInterrupt:
+        pass
+    print("stopping fleet (graceful: replicas drain in-flight work)",
+          flush=True)
+    server.shutdown()
+    fleet.stop(graceful=True)
+    server.server_close()
+    return 0
+
+
+def _cmd_route(argv) -> int:
+    """Stand-alone router over ALREADY-RUNNING replicas (spawned by
+    `serve` on other hosts/ports, or by an external scheduler)."""
+    from .serving.router import Router, make_router_server
+
+    known = {"replica": list, "host": str, "port": str,
+             "probe_interval_ms": str, "request_timeout_ms": str}
+    opts = _parse_kv(argv, known)
+    urls = opts.get("replica", [])
+    if not urls:
+        raise SystemExit("route requires at least one "
+                         "--replica http://host:port")
+    router = Router(
+        replicas=urls,
+        probe_interval_s=float(opts.get("probe_interval_ms", 500)) / 1e3,
+        request_timeout_s=float(
+            opts.get("request_timeout_ms", 120000)) / 1e3)
+    server = make_router_server(
+        router, host=opts.get("host", "127.0.0.1"),
+        port=int(opts.get("port", 8866)))
+    router.start()
+    print(f"routing {len(urls)} replica(s) on "
+          f"http://{server.server_address[0]}:{server.port}", flush=True)
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        router.close()
+        server.server_close()
     return 0
 
 
@@ -560,6 +722,8 @@ def main(argv=None) -> int:
         return _cmd_merge_model(rest)
     if cmd == "serve":
         return _cmd_serve(rest)
+    if cmd == "route":
+        return _cmd_route(rest)
     if cmd == "tune":
         return _cmd_tune(rest)
     if cmd == "stats":
@@ -573,7 +737,7 @@ def main(argv=None) -> int:
         print(full_version)
         return 0
     raise SystemExit(f"unknown command {cmd!r}; try: train, merge_model, "
-                     "serve, tune, stats, flags, version")
+                     "serve, route, tune, stats, flags, version")
 
 
 if __name__ == "__main__":

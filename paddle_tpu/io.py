@@ -48,6 +48,7 @@ __all__ = [
     "load_persistables",
     "save_inference_model",
     "load_inference_model",
+    "apply_sharding_meta",
     "save_checkpoint",
     "load_checkpoint",
     "clean_checkpoint",
@@ -313,6 +314,11 @@ def save_inference_model(
     # its device-resident slot pool (and pre-compile the pool step at
     # warmup) without re-tracing the model source
     generation = _generation_meta(pruned)
+    # sharding sidecar: partition specs of mesh-sharded parameters
+    # (parallel/sharded_embedding.py sets var.sharding) so a serving
+    # replica can BE a mesh — load_inference_model re-attaches the
+    # specs and ServingEngine(mesh=...) places params accordingly
+    sharding = _sharding_meta(pruned)
     with open(os.path.join(dirname, PROGRAM_FILE), "w") as f:
         json.dump(pruned.to_dict(), f)
     with open(os.path.join(dirname, META_FILE), "w") as f:
@@ -324,9 +330,61 @@ def save_inference_model(
                 "feed_specs": feed_specs,
                 "tuning": tuning,
                 **({"generation": generation} if generation else {}),
+                **({"sharding": sharding} if sharding else {}),
             },
             f,
         )
+
+
+def _sharding_meta(pruned: Program) -> Optional[dict]:
+    """meta.json sidecar for mesh-sharded models: per-variable partition
+    specs (one entry per dim: axis name, list of axis names, or null =
+    replicated) plus the mesh axes they reference, JSON-shaped so the
+    artifact stays backend-agnostic. Only vars carrying an explicit
+    `.sharding` PartitionSpec (e.g. parallel.sharded_embedding tables)
+    are recorded — everything else is replicated at serving time."""
+    specs: Dict[str, list] = {}
+    axes: set = set()
+    for block in pruned.blocks:
+        for v in block.vars.values():
+            spec = getattr(v, "sharding", None)
+            if spec is None:
+                continue
+            entry = []
+            for dim in tuple(spec):
+                if dim is None:
+                    entry.append(None)
+                elif isinstance(dim, (tuple, list)):
+                    entry.append([str(a) for a in dim])
+                    axes.update(str(a) for a in dim)
+                else:
+                    entry.append(str(dim))
+                    axes.add(str(dim))
+            specs[v.name] = entry
+    if not specs:
+        return None
+    return {"specs": specs, "mesh_axes": sorted(axes)}
+
+
+def apply_sharding_meta(program: Program, meta: Optional[dict]) -> int:
+    """Re-attach partition specs from a sharding sidecar onto the
+    program's variables (the load-side inverse of `_sharding_meta`).
+    Returns the number of vars annotated. Idempotent; unknown var names
+    are skipped (the pruned slice may have dropped them)."""
+    if not meta:
+        return 0
+    from jax.sharding import PartitionSpec
+
+    n = 0
+    for block in program.blocks:
+        for name, entry in meta.get("specs", {}).items():
+            v = block.vars.get(name)
+            if v is None:
+                continue
+            v.sharding = PartitionSpec(
+                *[tuple(d) if isinstance(d, list) else d for d in entry])
+            n += 1
+    return n
 
 
 def _generation_meta(pruned: Program) -> Optional[dict]:
@@ -385,6 +443,11 @@ def load_inference_model(dirname: str, scope: Optional[Scope] = None):
     # artifacts): beam geometry + decode-state specs, consumed by
     # serving.scheduler.ContinuousScheduler warmup
     program._generation_meta = meta.get("generation") or None
+    # sharding sidecar (absent for unsharded models): partition specs of
+    # mesh-sharded parameters, re-attached to the restored vars so a
+    # mesh ServingEngine (or ParallelExecutor) places them sharded
+    program._sharding_meta = meta.get("sharding") or None
+    apply_sharding_meta(program, program._sharding_meta)
     return program, meta["feed_names"], meta["fetch_names"]
 
 

@@ -223,6 +223,13 @@ class MetricsRegistry:
             if fn not in self._collectors:
                 self._collectors.append(fn)
 
+    def remove_collector(self, fn: _Collector) -> None:
+        """Detach a render-time collector (a closed Router removes its
+        fleet families so a long-lived process doesn't scrape ghosts)."""
+        with self._lock:
+            if fn in self._collectors:
+                self._collectors.remove(fn)
+
     def reset_metrics(self) -> None:
         """Drop all registered series (test isolation via pt.reset());
         collectors stay — they read external module state that owns its

@@ -35,7 +35,18 @@ from .distributed import (  # noqa: F401
     process_count,
     process_index,
 )
-from .mesh import DP, MP, PP, SP, batch_sharded, dim_sharded, make_mesh, replicated  # noqa: F401
+from .mesh import (  # noqa: F401
+    DP,
+    MP,
+    PP,
+    SP,
+    batch_sharded,
+    dim_sharded,
+    make_mesh,
+    mesh_from_spec,
+    parse_mesh_spec,
+    replicated,
+)
 from .ring_attention import (  # noqa: F401
     ring_attention,
     scaled_dot_product_attention,

@@ -38,6 +38,40 @@ def make_mesh(
     return Mesh(arr, tuple(axis_names))
 
 
+def parse_mesh_spec(spec: str) -> Tuple[Tuple[str, int], ...]:
+    """"dp4,mp2" -> (("dp", 4), ("mp", 2)) — the textual mesh vocabulary
+    shared by bench.py's BENCH_MESH and `cli serve --mesh`."""
+    import re
+
+    axes = []
+    for part in filter(None, spec.split(",")):
+        m = re.fullmatch(r"([a-z]+)(\d+)", part.strip())
+        if not m:
+            raise ValueError(
+                f"bad mesh axis {part!r}; want e.g. dp4 or mp2")
+        axes.append((m.group(1), int(m.group(2))))
+    if not axes:
+        raise ValueError(f"empty mesh spec {spec!r}")
+    return tuple(axes)
+
+
+def mesh_from_spec(spec: str, devices=None) -> Mesh:
+    """Build a Mesh from "dp2,mp4" over a PREFIX of the device list (a
+    serving replica may own fewer chips than the host exposes; training
+    takes them all by passing an exact-size device list)."""
+    axes = parse_mesh_spec(spec)
+    need = int(np.prod([n for _, n in axes]))
+    devices = list(devices if devices is not None else jax.devices())
+    if need > len(devices):
+        raise ValueError(
+            f"mesh {spec!r} needs {need} devices, have {len(devices)}")
+    return make_mesh(
+        shape=tuple(n for _, n in axes),
+        axis_names=tuple(a for a, _ in axes),
+        devices=devices[:need],
+    )
+
+
 def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, PartitionSpec())
 

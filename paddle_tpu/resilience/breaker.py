@@ -111,6 +111,19 @@ class CircuitBreaker:
                 self._probes = 0
                 self.opens += 1
 
+    def trip(self) -> None:
+        """Force OPEN immediately, bypassing the consecutive-failure
+        threshold: for callers with out-of-band proof the backend is
+        gone (the router watching a replica PROCESS exit, a supervisor
+        reaping a SIGKILLed worker). Waiting out `failure_threshold`
+        doomed requests would just burn client deadlines."""
+        with self._lock:
+            if self._state != OPEN:
+                self.opens += 1
+            self._state = OPEN
+            self._opened_at = self._clock()
+            self._probes = 0
+
     # -- accounting -----------------------------------------------------
     def stats(self) -> Dict[str, object]:
         with self._lock:

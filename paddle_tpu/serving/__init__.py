@@ -24,8 +24,15 @@ bounded). Layers, bottom-up:
               view over the process-wide paddle_tpu.obs.metrics
               registry; /metrics renders the unified exposition
               (serving + trainer + faults + timers in one scrape).
+- `router`  — scale-out front-end: join-shortest-queue load balancing
+              over N replica processes with per-replica circuit
+              breakers, health probes, retry/failover, streaming
+              pass-through, warm-pool standby replicas, and fleet
+              gauges in the unified registry.
 
-CLI: `python -m paddle_tpu serve --model_dir <saved_inference_model>`.
+CLI: `python -m paddle_tpu serve --model_dir <saved_inference_model>`
+(add `--replicas N` for a router + replica fleet, or front existing
+replicas with `python -m paddle_tpu route --replica URL ...`).
 """
 
 from ..resilience.breaker import CircuitBreaker, CircuitOpenError  # noqa: F401
@@ -35,9 +42,22 @@ from .batcher import (AdmissionQueue, DeadlineError,  # noqa: F401
 from .metrics import Histogram, MetricSet  # noqa: F401
 from .scheduler import (ContinuousScheduler, GenerationAborted,  # noqa: F401
                         GenHandle)
-from .server import ModelRegistry, ServingServer, make_server  # noqa: F401
+from .server import (REQUEST_ID_HEADER, ModelRegistry,  # noqa: F401
+                     ServingServer, make_server)
+from .router import (Fleet, NoReplicaError, ReplicaProcess,  # noqa: F401
+                     Router, RouterServer, WarmPool, make_router_server,
+                     replica_spawner)
 
 __all__ = [
+    "Fleet",
+    "NoReplicaError",
+    "REQUEST_ID_HEADER",
+    "ReplicaProcess",
+    "Router",
+    "RouterServer",
+    "WarmPool",
+    "make_router_server",
+    "replica_spawner",
     "BucketPolicy",
     "ServingEngine",
     "MicroBatcher",

@@ -148,9 +148,13 @@ class _Request:
     __slots__ = ("feed", "rows", "future", "deadline", "signature",
                  "request_id")
 
-    def __init__(self, feed: Dict[str, np.ndarray], deadline: float):
+    def __init__(self, feed: Dict[str, np.ndarray], deadline: float,
+                 request_id: Optional[str] = None):
         self.feed = feed
-        self.request_id = obs_trace.new_request_id()
+        # a router-minted id (X-PT-Request-Id) is adopted so one trace
+        # shows router pick → replica queue → engine call for a request;
+        # locally-submitted requests mint their own
+        self.request_id = request_id or obs_trace.new_request_id()
         rows = {v.shape[0] for v in feed.values() if v.ndim >= 1}
         if len(rows) != 1:
             raise ValueError(
@@ -239,11 +243,13 @@ class MicroBatcher:
 
     # -- client side ----------------------------------------------------
     def submit(self, feed: Dict[str, np.ndarray],
-               timeout_ms: Optional[float] = None) -> Future:
+               timeout_ms: Optional[float] = None,
+               request_id: Optional[str] = None) -> Future:
         req = _Request(
             feed,
             time.monotonic() + (timeout_ms / 1e3 if timeout_ms is not None
-                                else self.timeout_s))
+                                else self.timeout_s),
+            request_id=request_id)
         if req.rows > self.max_batch_size:
             raise ValueError(
                 f"request rows {req.rows} exceed max_batch_size "
@@ -270,14 +276,16 @@ class MicroBatcher:
         return req.future
 
     def predict(self, feed: Dict[str, np.ndarray],
-                timeout_ms: Optional[float] = None) -> List[np.ndarray]:
+                timeout_ms: Optional[float] = None,
+                request_id: Optional[str] = None) -> List[np.ndarray]:
         """submit + wait. Raises ShedError / DeadlineError / the
         engine's exception. The wait allows the deadline plus an equal
         grace (min 1 s) for a dispatch already in flight — a cold
         bucket compile on the first request may exceed the deadline
         alone; warm the engine (ServingEngine.warmup) to avoid
         first-request 504s."""
-        fut = self.submit(feed, timeout_ms=timeout_ms)
+        fut = self.submit(feed, timeout_ms=timeout_ms,
+                          request_id=request_id)
         budget = (timeout_ms / 1e3 if timeout_ms is not None
                   else self.timeout_s)
         try:

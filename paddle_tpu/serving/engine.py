@@ -39,7 +39,9 @@ is visible in /metrics rather than silent.
 
 from __future__ import annotations
 
+import os
 import threading
+import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -131,6 +133,8 @@ class ServingEngine:
         policy: Optional[BucketPolicy] = None,
         model_name: str = "default",
         metrics: Optional[MetricSet] = None,
+        mesh=None,
+        batch_axis: Optional[str] = None,
     ):
         self.model_name = model_name
         self.policy = policy or BucketPolicy()
@@ -138,6 +142,47 @@ class ServingEngine:
         self.program, self.feed_names, self.fetch_names = (
             load_inference_model(model_dir, scope=self.scope)
         )
+        # mesh-sharded replica (scale-out serving): with `mesh` given,
+        # the engine runs over ParallelExecutor — parameters carrying a
+        # partition spec (restored by load_inference_model from the
+        # artifact's sharding sidecar) are placed sharded over the mesh,
+        # everything else replicated, so ONE large model serves across
+        # chips while the HTTP surface stays identical to a one-device
+        # replica. batch_axis defaults to "dp" when the mesh has it,
+        # else feeds are effectively replicated (dp absent ⇒ no feed
+        # axis to shard over).
+        self.mesh = mesh
+        self.sharding_meta = getattr(self.program, "_sharding_meta", None)
+        if mesh is not None:
+            from ..parallel.data_parallel import ParallelExecutor
+            from ..parallel.mesh import DP
+
+            axis_names = tuple(mesh.axis_names)
+            missing = [
+                a for a in (self.sharding_meta or {}).get("mesh_axes", [])
+                if a not in axis_names
+            ]
+            if missing:
+                raise ValueError(
+                    f"model {model_name!r} was exported with parameters "
+                    f"sharded over mesh axes {missing} which the serving "
+                    f"mesh {axis_names} does not have")
+            if batch_axis is None:
+                batch_axis = DP if DP in axis_names else axis_names[0]
+            d = int(mesh.shape.get(batch_axis, 1))
+            if d > 1:
+                bad = [b for b in self.policy.batch_buckets if b % d]
+                if bad:
+                    raise ValueError(
+                        f"batch buckets {bad} are not divisible by the "
+                        f"mesh's {batch_axis}={d} axis; pass a policy "
+                        f"whose buckets are multiples of {d}")
+            self.batch_axis = batch_axis
+            self.exe: Executor = ParallelExecutor(
+                mesh=mesh, batch_axis=batch_axis)
+        else:
+            self.batch_axis = None
+            self.exe = Executor()
         self.feed_specs: Dict[str, Dict[str, Any]] = {}
         # meta.json (io.save_inference_model) records feed dtypes/shapes
         # since the serving PR; older artifacts fall back to program vars
@@ -166,9 +211,18 @@ class ServingEngine:
         self._gen_spec = (_G.gen_spec_from_op(_gen_op)
                           if _gen_op is not None else None)
         self._scheduler = None
-        self.exe = Executor()
         self.metrics = metrics or MetricSet(
             stat_set=profiler.global_stat_set())
+        # fleet-bench CPU proxy: with PT_SERVING_SIM_STEP_MS set, every
+        # engine call pays that much wall time inside the lock (sleep —
+        # GIL released), standing in for the per-dispatch device latency
+        # a real accelerator replica would serialize on. This is what
+        # makes QPS-vs-replicas measurable on a 1-core CI host: the
+        # router/fleet plumbing under test is host-side, the simulated
+        # device time scales per-replica exactly like real chips do.
+        # Never set in production; bench.py serving_scale documents it.
+        self._sim_step_s = float(
+            os.environ.get("PT_SERVING_SIM_STEP_MS", "0")) / 1e3
         self._lock = threading.RLock()
         self._seen_buckets: Dict[tuple, int] = {}
         self.cache_hits = 0
@@ -289,8 +343,6 @@ class ServingEngine:
         bucketed=False bypasses padding entirely — the exact-shape
         oracle path (one compile per novel shape); tests pin the
         bucketed path's numerics against it."""
-        import time
-
         t0 = time.perf_counter()
         with self._lock, profiler.timer(
                 f"serving/{self.model_name}/predict", always=True):
@@ -298,6 +350,8 @@ class ServingEngine:
             # failure — it must fan out to the batch, feed the circuit
             # breaker, and surface as HTTP 500, never wedge the worker
             faults.fire("serving.predict", model=self.model_name)
+            if self._sim_step_s:
+                time.sleep(self._sim_step_s)  # fleet-bench device proxy
             if bucketed:
                 padded, n, seq_lens = self._pad_feed(feed)
                 nb = next(iter(padded.values())).shape[0]
@@ -593,6 +647,13 @@ class ServingEngine:
                 "bucket_counts": {
                     str(k[1]): c for k, c in self._seen_buckets.items()
                 },
+                **({"mesh": {
+                    "axes": {str(a): int(self.mesh.shape[a])
+                             for a in self.mesh.axis_names},
+                    "batch_axis": self.batch_axis,
+                    "sharded_params": sorted(
+                        (self.sharding_meta or {}).get("specs", {})),
+                }} if self.mesh is not None else {}),
                 **({"generation": self._scheduler.stats()}
                    if self._scheduler is not None else {}),
             }

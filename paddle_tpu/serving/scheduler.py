@@ -124,14 +124,17 @@ class _GenRequest:
                  "next_row", "live_rows", "results", "failed",
                  "request_id")
 
-    def __init__(self, feed, rows: int, deadline: float):
+    def __init__(self, feed, rows: int, deadline: float,
+                 request_id: Optional[str] = None):
         self.feed = feed
         self.rows = rows
         # correlation key: every span this request touches — enqueue on
         # the client thread, admit/prefix/first-token/retire on the
         # scheduler worker, the HTTP span on the handler thread —
-        # carries this id (ISSUE 8 queue→admit→pool-step→stream flow)
-        self.request_id = obs_trace.new_request_id("gen")
+        # carries this id (ISSUE 8 queue→admit→pool-step→stream flow).
+        # A router-minted id (X-PT-Request-Id) is adopted verbatim so
+        # the router hop joins the same chain.
+        self.request_id = request_id or obs_trace.new_request_id("gen")
         self.handle = GenHandle(rows)
         self.handle.request_id = self.request_id
         self.deadline = deadline
@@ -304,9 +307,15 @@ class ContinuousScheduler:
             self._worker.start()
         return self
 
-    def stop(self, drain: bool = False) -> None:
+    def stop(self, drain: bool = False,
+             drain_timeout_s: float = 60.0) -> None:
+        """Stop the pool worker. drain=True lets queued + in-flight
+        generation finish first (bounded by drain_timeout_s) — the
+        graceful half of the replica SIGTERM contract; whatever is
+        still in flight past the bound fails with a retryable
+        ShedError so a router can re-run it elsewhere."""
         if drain:
-            deadline = time.monotonic() + 60.0
+            deadline = time.monotonic() + drain_timeout_s
             while time.monotonic() < deadline:
                 with self._cond:
                     if not self._aq._q and not self._active.any() \
@@ -325,7 +334,8 @@ class ContinuousScheduler:
 
     # -- client side ----------------------------------------------------
     def submit(self, feed: Dict[str, np.ndarray],
-               timeout_ms: Optional[float] = None) -> GenHandle:
+               timeout_ms: Optional[float] = None,
+               request_id: Optional[str] = None) -> GenHandle:
         if self.breaker is not None and not self.breaker.admit():
             self.metrics.counter_inc(
                 "circuit_open_total",
@@ -343,7 +353,7 @@ class ContinuousScheduler:
         n = rows.pop()
         deadline = time.monotonic() + (
             timeout_ms / 1e3 if timeout_ms is not None else self.timeout_s)
-        req = _GenRequest(feed, n, deadline)
+        req = _GenRequest(feed, n, deadline, request_id=request_id)
         with self._cond:
             if self._stopping:
                 raise ShedError("scheduler stopped")

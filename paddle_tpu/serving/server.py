@@ -43,6 +43,7 @@ from __future__ import annotations
 
 import json
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, Optional, Tuple
 
@@ -55,7 +56,13 @@ from .batcher import DeadlineError, MicroBatcher, ShedError
 from .engine import BucketPolicy, ServingEngine
 from .metrics import MetricSet, _sanitize
 
-__all__ = ["ModelRegistry", "ServingServer", "make_server"]
+__all__ = ["ModelRegistry", "ServingServer", "make_server",
+           "REQUEST_ID_HEADER"]
+
+# correlation-id header: minted (or forwarded) by the router, adopted by
+# replicas, echoed on responses — the key that stitches one request's
+# spans across the router and replica processes (obs.trace request_id)
+REQUEST_ID_HEADER = "X-PT-Request-Id"
 
 
 class ModelRegistry:
@@ -76,13 +83,15 @@ class ModelRegistry:
         policy: Optional[BucketPolicy] = None,
         breaker: Optional[CircuitBreaker] = None,
         scheduler_kw: Optional[dict] = None,
+        mesh=None,
         **batcher_kw,
     ) -> Tuple[ServingEngine, MicroBatcher]:
         if engine is None:
             if model_dir is None:
                 raise ValueError("add() needs model_dir or engine")
             engine = ServingEngine(model_dir, policy=policy,
-                                   model_name=name, metrics=self.metrics)
+                                   model_name=name, metrics=self.metrics,
+                                   mesh=mesh)
         if batcher is None:
             # every registry-built model gets a circuit breaker: a model
             # whose engine keeps failing must 503 fast, not queue-then-500
@@ -127,11 +136,20 @@ class ModelRegistry:
                 e._scheduler.start()
         return self
 
-    def stop(self) -> None:
+    def stop(self, drain_s: float = 0.0) -> None:
+        """Stop every model's batcher + scheduler. drain_s > 0 is the
+        graceful-shutdown contract (replica SIGTERM): queued predict
+        work and in-flight generation STREAMS finish first, bounded by
+        drain_s overall — whatever is still running past the bound
+        fails with a retryable ShedError so a router fails it over
+        instead of a client seeing a torn stream."""
+        deadline = time.monotonic() + drain_s
         for e, b in self._models.values():
-            b.stop()
+            b.stop(drain=drain_s > 0)
             if e._scheduler is not None:
-                e._scheduler.stop()
+                e._scheduler.stop(
+                    drain=drain_s > 0,
+                    drain_timeout_s=max(0.0, deadline - time.monotonic()))
 
     def stats(self) -> Dict[str, dict]:
         out = {}
@@ -148,6 +166,34 @@ class ModelRegistry:
         return {
             n: (b.breaker.state() if b.breaker is not None else "closed")
             for n, (_, b) in self._models.items()
+        }
+
+    def load(self) -> Dict[str, float]:
+        """Aggregate load snapshot for /healthz: admission-queue depth
+        (predict + generation), active/total decode slots, and the
+        uniform dispatch/sync counters — everything a join-shortest-
+        queue router needs to score this replica, WITHOUT the cost (or
+        parse burden) of a full /metrics scrape. All reads are advisory
+        host ints (no locks beyond what len() takes)."""
+        queue_depth = active = slots = dispatches = syncs = 0
+        for e, b in self._models.values():
+            queue_depth += len(b._q)
+            dispatches += e.dispatches_total
+            syncs += e.syncs_total
+            s = e._scheduler
+            if s is not None:
+                queue_depth += s._aq.depth()
+                active += int(s._active.sum())
+                slots += s.max_slots
+                dispatches += s.dispatches_total
+                syncs += s.syncs_total
+        return {
+            "queue_depth": queue_depth,
+            "active_slots": active,
+            "max_slots": slots,
+            "slot_occupancy": (active / slots) if slots else 0.0,
+            "dispatches_total": dispatches,
+            "syncs_total": syncs,
         }
 
 
@@ -187,6 +233,11 @@ class _Handler(BaseHTTPRequestHandler):
                 "status": "degraded" if degraded else "ok",
                 "models": reg.names(),
                 "circuits": circuits,
+                # load block: queue depth + slot occupancy + dispatch
+                # counters, so a router's join-shortest-queue pick (and
+                # an operator's curl) reads load from the health probe
+                # it already makes instead of scraping full /metrics
+                "load": reg.load(),
             })
         elif self.path == "/metrics":
             self._send(200, reg.metrics.render().encode(),
@@ -223,11 +274,23 @@ class _Handler(BaseHTTPRequestHandler):
             return
         self._error(404, f"no route {self.path!r}")
 
+    def _request_id(self, prefix: str) -> str:
+        """Adopt the router's correlation id (X-PT-Request-Id) or mint
+        one: the id a request carries through the batcher/scheduler —
+        and every span on the way — is the SAME id the router tagged
+        the hop with, so one Perfetto capture shows router pick →
+        replica queue → pool step → stream for a single request."""
+        return (self.headers.get(REQUEST_ID_HEADER)
+                or obs_trace.new_request_id(prefix))
+
     def _predict(self, name, engine, batcher, feed, req):
+        rid = self._request_id("req")
         try:
-            with obs_trace.span("http.predict", cat="http", model=name):
+            with obs_trace.span("http.predict", cat="http", model=name,
+                                request_id=rid):
                 outs = batcher.predict(
-                    feed, timeout_ms=req.get("timeout_ms"))
+                    feed, timeout_ms=req.get("timeout_ms"),
+                    request_id=rid)
         except (ShedError, CircuitOpenError) as e:
             self._error(503, str(e))
             return
@@ -243,7 +306,7 @@ class _Handler(BaseHTTPRequestHandler):
                 fn: np.asarray(o).tolist()
                 for fn, o in zip(engine.fetch_names, outs)
             },
-        })
+        }, extra_headers=((REQUEST_ID_HEADER, rid),))
 
     # -- generation (continuous batching) -------------------------------
     @staticmethod
@@ -265,11 +328,16 @@ class _Handler(BaseHTTPRequestHandler):
             self._error(400, str(e))
             return
         timeout_ms = req.get("timeout_ms")
+        rid = self._request_id("gen")
         if not req.get("stream"):
             try:
                 with obs_trace.span("http.generate", cat="http",
-                                    model=name):
-                    outputs = sched.generate(feed, timeout_ms=timeout_ms)
+                                    model=name, request_id=rid):
+                    h = sched.submit(feed, timeout_ms=timeout_ms,
+                                     request_id=rid)
+                    budget = (timeout_ms / 1e3 if timeout_ms is not None
+                              else sched.timeout_s)
+                    outputs = h.result(timeout=budget + max(1.0, budget))
             except (ShedError, CircuitOpenError) as e:
                 # GenerationAborted is a ShedError: retryable 503
                 self._error(503, str(e))
@@ -281,19 +349,22 @@ class _Handler(BaseHTTPRequestHandler):
                 self._error(500, f"{type(e).__name__}: {e}")
                 return
             self._send(200, {"model": name,
-                             "outputs": self._outputs_json(outputs)})
+                             "outputs": self._outputs_json(outputs)},
+                       extra_headers=((REQUEST_ID_HEADER, rid),))
             return
         # streaming: admission errors still map to clean HTTP statuses;
         # once the stream is open, failures arrive as terminal
         # {"event": "error"} lines (the status is already on the wire)
         try:
-            handle = sched.submit(feed, timeout_ms=timeout_ms)
+            handle = sched.submit(feed, timeout_ms=timeout_ms,
+                                  request_id=rid)
         except (ShedError, CircuitOpenError) as e:
             self._error(503, str(e))
             return
         self.send_response(200)
         self.send_header("Content-Type", "application/x-ndjson")
         self.send_header("Transfer-Encoding", "chunked")
+        self.send_header(REQUEST_ID_HEADER, handle.request_id)
         self.end_headers()
         try:
             # the stream span lives on the HTTP handler thread and

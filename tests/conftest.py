@@ -43,6 +43,11 @@ def pytest_configure(config):
         "chaos: fault-injection / crash-recovery tests "
         "(paddle_tpu.resilience); the fast deterministic subset runs in "
         "tier-1, subprocess e2e cases are additionally marked slow")
+    config.addinivalue_line(
+        "markers",
+        "fleet: multi-process router/fleet e2e tests "
+        "(paddle_tpu.serving.router) that SPAWN replica subprocesses; "
+        "in tier-1 but individually time-bounded like test_chaos")
     # hung multi-process / subprocess tests must leave a diagnosis: dump
     # every thread's traceback shortly before the tier-1 `timeout -k`
     # wrapper would SIGKILL the run (and again every interval for longer

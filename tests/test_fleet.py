@@ -1,0 +1,380 @@
+"""Fleet e2e + replica-shutdown drain contracts (ISSUE 9).
+
+Two layers:
+
+- In-process: ModelRegistry.stop(drain_s=...) must let in-flight
+  generation STREAMS finish (the replica half of graceful shutdown —
+  before this PR only the trainer had a preemption drain contract),
+  verified with artificially slowed pool steps so the stop provably
+  lands mid-stream.
+
+- Subprocess (`fleet` marker, time-bounded like test_chaos): real
+  `python -m paddle_tpu serve` replicas behind the router.
+  * SIGTERM mid-stream → the replica drains: the client's NDJSON
+    stream ends in "done", never an error, and the process exits 0.
+  * The chaos acceptance: SIGKILL one replica under load → the router
+    trips that replica's breaker and fails requests over; a warmed
+    standby is promoted; clients see ZERO non-retryable errors
+    (200s throughout, or 503+Retry-After at worst), and after the
+    probe admits the replacement the fleet serves clean.
+"""
+
+import json
+import os
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.serving import ModelRegistry, Router, make_router_server
+from paddle_tpu.serving.router import Fleet, ReplicaProcess, \
+    replica_spawner
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+H, V, E = 8, 16, 6
+BOS, EOS = 0, 1
+
+
+def _build_gen_model(dirname: str, max_len: int = 64) -> None:
+    """Tiny GRU-ish LM decoder (test_gen_serving's shape). Random
+    weights rarely emit EOS, so decode runs ~max_len steps — long
+    enough that a shutdown provably lands mid-stream."""
+    pt.reset()
+    pt.default_startup_program().random_seed = 3
+    h0 = pt.layers.data("h0", shape=[-1, H], append_batch_size=False)
+    gen = pt.layers.BeamSearchDecoder(beam_size=2, max_len=max_len,
+                                      bos_id=BOS, eos_id=EOS)
+    with gen.step():
+        prev = gen.prev_ids()
+        h_prev = gen.memory(init=h0)
+        emb = pt.layers.embedding(prev, size=[V, E], param_attr="g_emb")
+        h = pt.layers.fc(
+            pt.layers.concat([emb, h_prev], axis=1), size=H, act="tanh",
+            param_attr="g_w", bias_attr=pt.ParamAttr(name="g_b"))
+        gen.update_memory(h_prev, h)
+        gen.output_logits(pt.layers.fc(
+            h, size=V, param_attr="g_wo",
+            bias_attr=pt.ParamAttr(name="g_bo")))
+    ids, scores, lengths = gen()
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    pt.io.save_inference_model(dirname, ["h0"], [ids, scores, lengths])
+
+
+def _build_dense_model(dirname: str) -> None:
+    pt.reset()
+    pt.default_startup_program().random_seed = 3
+    x = pt.layers.data("x", shape=[4])
+    h = pt.layers.fc(x, size=8, act="relu")
+    pred = pt.layers.fc(h, size=1)
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    pt.io.save_inference_model(dirname, ["x"], [pred])
+
+
+@pytest.fixture(scope="module")
+def gen_model_dir(tmp_path_factory):
+    d = str(tmp_path_factory.mktemp("fleet_gen"))
+    _build_gen_model(d)
+    return d
+
+
+@pytest.fixture(scope="module")
+def dense_model_dir(tmp_path_factory):
+    d = str(tmp_path_factory.mktemp("fleet_dense"))
+    _build_dense_model(d)
+    return d
+
+
+def _subprocess_env():
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env["PYTHONPATH"] = REPO_ROOT + (
+        os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else "")
+    env.pop("PT_FLAGS_FAULT_SPEC", None)
+    return env
+
+
+# ----------------------------------------------- in-process drain -----------
+
+
+def test_registry_stop_drains_inflight_generation_stream(gen_model_dir):
+    """stop(drain_s) called MID-STREAM lets the stream finish: the
+    client sees every token and a terminal done — never an error."""
+    reg = ModelRegistry()
+    engine, _ = reg.add("g", model_dir=gen_model_dir,
+                        scheduler_kw=dict(max_slots=2, max_queue=4,
+                                          timeout_ms=60000.0))
+    reg.start()
+    sched = engine.scheduler()
+    orig = sched._step_once
+
+    def slow_step():
+        time.sleep(0.01)  # ~64 steps ⇒ the stream is up ~0.6s
+        return orig()
+
+    sched._step_once = slow_step
+    h = sched.submit({"h0": np.zeros((1, H), np.float32)})
+    events, done = [], threading.Event()
+
+    def consume():
+        for ev in h.events(timeout=60):
+            events.append(ev)
+        done.set()
+
+    t = threading.Thread(target=consume, daemon=True)
+    t.start()
+    # wait until the stream is provably in flight (first token out)
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline and not any(
+            e["event"] == "token" for e in events):
+        time.sleep(0.005)
+    assert any(e["event"] == "token" for e in events)
+    t0 = time.monotonic()
+    reg.stop(drain_s=30.0)
+    assert done.wait(timeout=30)
+    assert events[-1]["event"] == "done", events[-1]
+    # the drain actually waited for the decode, not a no-op return
+    assert time.monotonic() - t0 > 0.05
+
+
+def test_registry_stop_without_drain_aborts_queued(gen_model_dir):
+    """The contrast case: drain_s=0 (default) fails queued work with a
+    RETRYABLE ShedError — a router would re-run it elsewhere."""
+    reg = ModelRegistry()
+    engine, _ = reg.add("g", model_dir=gen_model_dir,
+                        scheduler_kw=dict(max_slots=1, max_queue=8,
+                                          timeout_ms=60000.0))
+    reg.start()
+    sched = engine.scheduler()
+    orig = sched._step_once
+
+    def slow_step():
+        time.sleep(0.01)
+        return orig()
+
+    sched._step_once = slow_step
+    handles = [sched.submit({"h0": np.zeros((1, H), np.float32)})
+               for _ in range(3)]
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline and not sched._active.any():
+        time.sleep(0.005)
+    reg.stop()
+    kinds = set()
+    for h in handles:
+        for ev in h.events(timeout=30):
+            pass
+        kinds.add(ev["event"])
+        if ev["event"] == "error":
+            assert ev["kind"] in ("ShedError", "GenerationAborted"), ev
+    assert "error" in kinds  # at least the queued ones were failed
+
+
+# ----------------------------------------------- subprocess e2e -------------
+
+
+def _post(url, path, payload, timeout=30):
+    req = urllib.request.Request(
+        url + path, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    return urllib.request.urlopen(req, timeout=timeout)
+
+
+@pytest.mark.fleet
+def test_replica_sigterm_drains_stream_then_exits_zero(gen_model_dir):
+    """SIGTERM lands while an NDJSON generation stream is in flight:
+    the `cli serve` handler drains — the stream ends with done, the
+    process exits 0 (parity with the trainer's preemption drain)."""
+    t_start = time.monotonic()
+    proc = ReplicaProcess(
+        ["--model_dir", gen_model_dir, "--gen_timeout_ms", "60000"],
+        env=_subprocess_env())
+    try:
+        url = proc.wait_ready(timeout=180)
+        resp = _post(url, "/generate",
+                     {"inputs": {"h0": [[0.0] * H]}, "stream": True},
+                     timeout=60)
+        events = []
+        line = resp.readline()  # first token: the stream is in flight
+        events.append(json.loads(line))
+        proc.terminate()  # SIGTERM mid-stream
+        for line in resp:
+            if line.strip():
+                events.append(json.loads(line))
+        assert events[0]["event"] == "token"
+        assert events[-1]["event"] == "done", events[-1]
+        assert all(e["event"] != "error" for e in events)
+        assert proc.wait(timeout=60) == 0, proc.output_tail()
+    finally:
+        proc.kill()
+    assert time.monotonic() - t_start < 300
+
+
+@pytest.mark.fleet
+@pytest.mark.chaos
+def test_sigkill_under_load_fails_over_zero_nonretryable(dense_model_dir):
+    """THE ISSUE 9 chaos acceptance. 2 replicas + 1 warm standby under
+    client load; SIGKILL one replica. Required outcomes:
+      - the router trips the killed replica's breaker,
+      - in-flight/subsequent requests fail over (200) or surface as
+        RETRYABLE 503s (Retry-After present) — zero non-retryable
+        errors at any point,
+      - the warm standby is promoted and, once probed up, the fleet
+        serves clean again with no operator action."""
+    t_start = time.monotonic()
+    spawn = replica_spawner(
+        ["--model_dir", dense_model_dir, "--max_batch_size", "8"],
+        env=_subprocess_env())
+    router = Router(probe_interval_s=0.1, probe_timeout_s=2.0,
+                    request_timeout_s=20.0,
+                    breaker_kw=dict(failure_threshold=2,
+                                    reset_timeout_s=0.5))
+    fleet = Fleet(spawn, replicas=2, standby=1, router=router,
+                  supervise_interval_s=0.1)
+    fleet.start()
+    srv = make_router_server(router)
+    srv.serve_background()
+    url = f"http://127.0.0.1:{srv.port}"
+    try:
+        # warm standby must be parked before the kill
+        deadline = time.monotonic() + 180
+        while time.monotonic() < deadline \
+                and fleet.warm.ready_count() < 1:
+            time.sleep(0.1)
+        assert fleet.warm.ready_count() >= 1
+
+        outcomes = {"ok": 0, "retryable_503": 0, "non_retryable": []}
+        stop = threading.Event()
+        lock = threading.Lock()
+
+        def client():
+            payload = {"inputs": {"x": [[0.1, 0.2, 0.3, 0.4]]}}
+            while not stop.is_set():
+                try:
+                    with _post(url, "/predict", payload) as r:
+                        r.read()
+                    with lock:
+                        outcomes["ok"] += 1
+                except urllib.error.HTTPError as e:
+                    retryable = (e.code == 503
+                                 and e.headers.get("Retry-After"))
+                    with lock:
+                        if retryable:
+                            outcomes["retryable_503"] += 1
+                        else:
+                            outcomes["non_retryable"].append(
+                                (e.code, e.read()[:200]))
+                except Exception as e:  # noqa: BLE001
+                    with lock:
+                        outcomes["non_retryable"].append(repr(e))
+                time.sleep(0.01)
+
+        threads = [threading.Thread(target=client, daemon=True)
+                   for _ in range(4)]
+        for t in threads:
+            t.start()
+        time.sleep(1.0)  # load flowing on both replicas
+        victim = router.replicas()[0]
+        victim_name = victim.name
+        victim.process.kill()
+        # breaker trips (transport failures and/or supervisor trip)
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline \
+                and victim.breaker.state() != "open":
+            time.sleep(0.02)
+        assert victim.breaker.state() == "open"
+        # replacement promoted from the warm pool and probed up
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            names = [r.name for r in router.replicas()]
+            if victim_name not in names and len(names) == 2 and all(
+                    r.up and r.breaker.state() == "closed"
+                    for r in router.replicas()):
+                break
+            time.sleep(0.1)
+        post_readmit_floor = None
+        with lock:
+            post_readmit_floor = outcomes["ok"]
+        time.sleep(1.5)  # clean-serving window after re-admission
+        stop.set()
+        for t in threads:
+            t.join(timeout=10)
+        assert not outcomes["non_retryable"], outcomes
+        assert outcomes["ok"] > post_readmit_floor, (
+            "no successful traffic after the replacement was admitted")
+        assert len(router.replicas()) == 2
+        assert all(r.breaker.state() == "closed"
+                   for r in router.replicas())
+        assert fleet.replaced_total == 1
+        # the victim served traffic pre-kill (counter survives its
+        # removal from the rotation), and the promoted replica is
+        # taking traffic now
+        assert router.registry.counter_value(
+            "pt_router_routed_total",
+            labels={"replica": victim_name}) > 0
+        routed = router.stats()["routed"]
+        promoted = [r.name for r in router.replicas()
+                    if r.name != victim_name]
+        assert any(routed.get(n, 0) > 0 for n in promoted)
+    finally:
+        fleet.stop()
+        srv.shutdown()
+        srv.server_close()
+    assert time.monotonic() - t_start < 300
+
+
+@pytest.mark.fleet
+def test_cli_serve_replicas_flag_e2e(dense_model_dir):
+    """`cli serve --replicas 2` spawns the fleet and routes: requests
+    land on both replicas and /healthz + /metrics answer fleet-wide.
+    Exercises the CLI wiring itself (one spawn level deeper than the
+    Fleet-object test above)."""
+    import re
+    import subprocess
+    import sys
+
+    t_start = time.monotonic()
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "paddle_tpu", "serve",
+         "--model_dir", dense_model_dir, "--replicas", "2",
+         "--port", "0", "--probe_interval_ms", "100"],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        env=_subprocess_env(), text=True)
+    url = None
+    lines = []
+    try:
+        deadline = time.monotonic() + 240
+        while time.monotonic() < deadline:
+            line = proc.stdout.readline()
+            if not line:
+                break
+            lines.append(line)
+            m = re.search(r"routing .* on (http://[\w.\-]+:\d+)", line)
+            if m:
+                url = m.group(1)
+                break
+        assert url, "".join(lines)
+        payload = {"inputs": {"x": [[0.1, 0.2, 0.3, 0.4]]}}
+        for _ in range(8):
+            with _post(url, "/predict", payload) as r:
+                out = json.loads(r.read())
+            assert "outputs" in out
+        stats = json.loads(urllib.request.urlopen(
+            url + "/stats", timeout=10).read())
+        assert len(stats["replicas"]) == 2
+        assert sum(stats["routed"].values()) == 8
+        assert all(v > 0 for v in stats["routed"].values()), stats
+        health = json.loads(urllib.request.urlopen(
+            url + "/healthz", timeout=10).read())
+        assert health["status"] == "ok"
+    finally:
+        proc.terminate()
+        try:
+            proc.wait(timeout=60)
+        except Exception:
+            proc.kill()
+    assert time.monotonic() - t_start < 300

@@ -1,0 +1,503 @@
+"""Router unit/integration tests over STUB replicas (ISSUE 9).
+
+Everything here runs against in-process stdlib HTTP stubs that speak
+the replica wire protocol (/healthz load block, /predict JSON,
+/generate chunked NDJSON) — no model, no jax subprocesses — so the
+routing contract (join-shortest-queue picking, shed/503 retry,
+transport failover + breaker trip, streaming pass-through, probe
+re-admission, fleet metrics, correlation ids) is pinned fast and
+deterministically. The real-fleet end-to-end (spawned `cli serve`
+replicas, SIGKILL chaos, warm-pool promotion) lives in test_fleet.py.
+"""
+
+import ast
+import json
+import os
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from paddle_tpu.obs import metrics as obs_metrics
+from paddle_tpu.obs import promparse
+from paddle_tpu.serving import REQUEST_ID_HEADER
+from paddle_tpu.serving.router import (NoReplicaError, Router,
+                                       make_router_server)
+
+# ---------------------------------------------------------------- stubs -----
+
+
+class _StubHandler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, fmt, *args):
+        pass
+
+    def _json(self, code, payload, extra=()):
+        body = json.dumps(payload).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        for k, v in extra:
+            self.send_header(k, v)
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):
+        s = self.server
+        if self.path == "/healthz":
+            s.probes += 1
+            self._json(200, {
+                "status": "ok", "models": ["default"],
+                "circuits": {"default": "closed"},
+                "load": dict(s.load),
+            })
+        else:
+            self._json(404, {"error": "no route"})
+
+    def do_POST(self):
+        s = self.server
+        n = int(self.headers.get("Content-Length", 0))
+        body = self.rfile.read(n)
+        rid = self.headers.get(REQUEST_ID_HEADER, "")
+        s.seen.append({"path": self.path, "rid": rid, "body": body})
+        if s.shed:
+            self._json(503, {"error": "queue full; retry later"},
+                       extra=(("Retry-After", "1"),))
+            return
+        if s.hang_s:
+            time.sleep(s.hang_s)
+        if self.path.startswith("/generate") and b'"stream"' in body:
+            self.send_response(200)
+            self.send_header("Content-Type", "application/x-ndjson")
+            self.send_header("Transfer-Encoding", "chunked")
+            self.end_headers()
+            for i in range(s.stream_tokens):
+                line = json.dumps({"event": "token", "token": i,
+                                   "who": s.name}).encode() + b"\n"
+                self.wfile.write(f"{len(line):x}\r\n".encode()
+                                 + line + b"\r\n")
+                self.wfile.flush()
+                if s.die_after_tokens and i + 1 >= s.die_after_tokens:
+                    # simulate the replica process dying mid-stream:
+                    # cut the TCP connection without a terminal chunk
+                    self.wfile.flush()
+                    self.connection.close()
+                    return
+            line = json.dumps({"event": "done",
+                               "outputs": {"ids": [[1]]}}).encode() + b"\n"
+            self.wfile.write(f"{len(line):x}\r\n".encode() + line + b"\r\n")
+            self.wfile.write(b"0\r\n\r\n")
+            return
+        self._json(200, {"who": s.name, "rid": rid},
+                   extra=((REQUEST_ID_HEADER, rid),) if rid else ())
+
+
+class StubReplica:
+    """One fake replica server with scriptable behavior."""
+
+    def __init__(self, name):
+        self.srv = ThreadingHTTPServer(("127.0.0.1", 0), _StubHandler)
+        self.srv.name = name
+        self.srv.shed = False
+        self.srv.hang_s = 0.0
+        self.srv.load = {"queue_depth": 0, "active_slots": 0,
+                         "max_slots": 0, "dispatches_total": 0,
+                         "syncs_total": 0}
+        self.srv.seen = []
+        self.srv.probes = 0
+        self.srv.stream_tokens = 3
+        self.srv.die_after_tokens = 0
+        self._thread = threading.Thread(target=self.srv.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+
+    @property
+    def url(self):
+        return f"http://127.0.0.1:{self.srv.server_address[1]}"
+
+    @property
+    def seen(self):
+        return self.srv.seen
+
+    def die(self):
+        """Hard death: stop serving AND close the listening socket so
+        new connections are refused (what a SIGKILLed process does)."""
+        self.srv.shutdown()
+        self.srv.server_close()
+
+    def close(self):
+        try:
+            self.die()
+        except OSError:
+            pass
+
+
+@pytest.fixture()
+def stubs():
+    made = []
+
+    def make(name, **attrs):
+        s = StubReplica(name)
+        for k, v in attrs.items():
+            setattr(s.srv, k, v)
+        made.append(s)
+        return s
+
+    yield make
+    for s in made:
+        s.close()
+
+
+@pytest.fixture()
+def router():
+    r = Router(probe_interval_s=0.05, probe_timeout_s=1.0,
+               request_timeout_s=5.0,
+               breaker_kw=dict(failure_threshold=2, reset_timeout_s=0.2))
+    yield r
+    r.close()
+
+
+def _post(url, path, payload, rid=None, timeout=10):
+    headers = {"Content-Type": "application/json"}
+    if rid:
+        headers[REQUEST_ID_HEADER] = rid
+    req = urllib.request.Request(url + path,
+                                 data=json.dumps(payload).encode(),
+                                 headers=headers)
+    return urllib.request.urlopen(req, timeout=timeout)
+
+
+# ---------------------------------------------------------------- picking ---
+
+
+def test_jsq_pick_prefers_least_loaded(router, stubs):
+    a, b = stubs("a"), stubs("b")
+    ra = router.add_replica(a.url)
+    rb = router.add_replica(b.url)
+    # feed snapshots by hand (the probe loop isn't running): b is busy
+    ra.snapshot = {"queue_depth": 0, "active_slots": 0}
+    rb.snapshot = {"queue_depth": 7, "active_slots": 2}
+    ra.up = rb.up = True
+    picked = router.pick()
+    assert picked is ra
+    router._release(picked)
+    # now a is carrying in-flight work heavier than b's queue
+    ra.inflight = 8
+    picked = router.pick()
+    assert picked is rb
+    router._release(picked)
+
+
+def test_jsq_ties_round_robin(router, stubs):
+    names = []
+    for i in range(3):
+        r = router.add_replica(stubs(f"s{i}").url, name=f"s{i}")
+        r.up = True
+    for _ in range(6):
+        p = router.pick()
+        names.append(p.name)
+        router._release(p)
+    # equal scores: every replica picked equally, no pile-on
+    assert sorted(names) == ["s0", "s0", "s1", "s1", "s2", "s2"]
+
+
+def test_pick_skips_open_breaker(router, stubs):
+    a, b = stubs("a"), stubs("b")
+    ra = router.add_replica(a.url)
+    rb = router.add_replica(b.url)
+    ra.breaker.trip()
+    for _ in range(4):
+        p = router.pick()
+        assert p is rb
+        router._release(p)
+    # trip the other too: nothing admittable
+    rb.breaker.trip()
+    assert router.pick() is None
+
+
+# ------------------------------------------------------------ dispatching ---
+
+
+def test_dispatch_retries_shed_on_other_replica(router, stubs):
+    shedding = stubs("shedder", shed=True)
+    healthy = stubs("healthy")
+    router.add_replica(shedding.url, name="shedder")
+    router.add_replica(healthy.url, name="healthy")
+    # force the shedding replica to be picked first every time
+    router._replicas["healthy"].snapshot = {"queue_depth": 50}
+    for _ in range(3):
+        lease = router.dispatch("/predict", b"{}")
+        assert lease.status == 200
+        assert json.loads(lease.body)["who"] == "healthy"
+        lease.close()
+    assert len(shedding.seen) == 3  # tried first, shed every time
+    assert router.registry.counter_value("pt_router_retried_total") == 3
+
+
+def test_dispatch_all_shed_relays_503(router, stubs):
+    for i in range(2):
+        router.add_replica(stubs(f"s{i}", shed=True).url)
+    lease = router.dispatch("/predict", b"{}")
+    assert lease.status == 503
+    assert any(k.lower() == "retry-after" for k, _ in lease.headers)
+    lease.close()
+
+
+def test_transport_failover_trips_breaker(router, stubs):
+    dead = stubs("dead")
+    live = stubs("live")
+    rd = router.add_replica(dead.url, name="dead")
+    router.add_replica(live.url, name="live")
+    dead.die()
+    router._replicas["live"].snapshot = {"queue_depth": 50}  # dead first
+    for _ in range(2):
+        lease = router.dispatch("/predict", b"{}")
+        assert lease.status == 200
+        assert json.loads(lease.body)["who"] == "live"
+        lease.close()
+    # failure_threshold=2: the dead replica's breaker is now open and
+    # pick() stops offering it — no more connection attempts
+    assert rd.breaker.state() == "open"
+    assert router.registry.counter_value(
+        "pt_router_failed_over_total", labels={"replica": "dead"}) == 2
+    lease = router.dispatch("/predict", b"{}")
+    lease.close()
+    assert router.registry.counter_value(
+        "pt_router_failed_over_total", labels={"replica": "dead"}) == 2
+
+
+def test_no_replica_raises_and_counts(router):
+    with pytest.raises(NoReplicaError):
+        router.dispatch("/predict", b"{}")
+    assert router.registry.counter_value(
+        "pt_router_unroutable_total") == 1
+
+
+def test_inflight_accounting_balances(router, stubs):
+    s = stubs("a")
+    ra = router.add_replica(s.url)
+    for _ in range(5):
+        lease = router.dispatch("/predict", b"{}")
+        assert ra.inflight == 1  # held until the relay finishes
+        lease.close()
+        assert ra.inflight == 0
+
+
+# ------------------------------------------------------- HTTP front-end -----
+
+
+@pytest.fixture()
+def front(router):
+    srv = make_router_server(router)
+    srv.serve_background()
+    yield f"http://127.0.0.1:{srv.port}", router
+    srv.shutdown()
+    srv.server_close()
+
+
+def test_request_id_minted_and_forwarded(front, stubs):
+    url, router = front
+    s = stubs("a")
+    router.add_replica(s.url)
+    with _post(url, "/predict", {"inputs": {}}) as resp:
+        rid = resp.headers.get(REQUEST_ID_HEADER)
+        body = json.loads(resp.read())
+    # minted at the router, forwarded to the replica, echoed back
+    assert rid and s.seen[-1]["rid"] == rid == body["rid"]
+    # a client-supplied id crosses both hops verbatim
+    with _post(url, "/predict", {"inputs": {}}, rid="req-cli-7") as resp:
+        assert resp.headers.get(REQUEST_ID_HEADER) == "req-cli-7"
+    assert s.seen[-1]["rid"] == "req-cli-7"
+
+
+def test_streaming_passes_through(front, stubs):
+    url, router = front
+    s = stubs("a", stream_tokens=4)
+    router.add_replica(s.url)
+    with _post(url, "/generate", {"inputs": {}, "stream": True}) as resp:
+        assert "ndjson" in resp.headers.get("Content-Type", "")
+        events = [json.loads(l) for l in resp.read().splitlines() if l]
+    assert [e["event"] for e in events] == ["token"] * 4 + ["done"]
+    assert all(e["who"] == "a" for e in events[:-1])
+
+
+def test_replica_death_mid_stream_emits_retryable_error(front, stubs):
+    """The replica-disappears-mid-stream contract: the client already
+    holds bytes, so no failover — the stream ends with a terminal
+    retryable error event and the replica's breaker took the hit."""
+    url, router = front
+    s = stubs("a", stream_tokens=10, die_after_tokens=2)
+    ra = router.add_replica(s.url)
+    with _post(url, "/generate", {"inputs": {}, "stream": True}) as resp:
+        events = [json.loads(l) for l in resp.read().splitlines() if l]
+    assert [e["event"] for e in events] == ["token", "token", "error"]
+    assert events[-1]["retryable"] is True
+    assert events[-1]["kind"] == "ReplicaLostError"
+    assert router.registry.counter_value(
+        "pt_router_failed_over_total", labels={"replica": ra.name}) == 1
+
+
+def test_unroutable_maps_to_503_with_retry_after(front):
+    url, _ = front
+    try:
+        _post(url, "/predict", {"inputs": {}})
+        assert False, "expected 503"
+    except urllib.error.HTTPError as e:
+        assert e.code == 503
+        assert e.headers.get("Retry-After") == "1"
+
+
+# ------------------------------------------------------------- probing ------
+
+
+def test_probe_fills_snapshots_and_health(front, stubs):
+    url, router = front
+    s = stubs("a")
+    s.srv.load = {"queue_depth": 5, "active_slots": 3, "max_slots": 8}
+    ra = router.add_replica(s.url)
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline and not ra.up:
+        time.sleep(0.02)
+    assert ra.up
+    assert ra.snapshot["queue_depth"] == 5
+    assert ra.snapshot["active_slots"] == 3
+    h = json.loads(urllib.request.urlopen(url + "/healthz",
+                                          timeout=5).read())
+    assert h["status"] == "ok"
+    assert h["replicas"][ra.name]["load"]["queue_depth"] == 5
+
+
+def test_probe_readmits_recovered_replica(router, stubs):
+    """Breaker open → replica comes back → the PROBE (not user
+    traffic) spends the half-open budget and closes the circuit."""
+    s = stubs("a")
+    ra = router.add_replica(s.url)
+    router.start()
+    ra.breaker.trip()
+    assert router.pick() is None
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline and ra.breaker.state() != "closed":
+        time.sleep(0.02)
+    assert ra.breaker.state() == "closed"
+    p = router.pick()
+    assert p is ra
+    router._release(p)
+
+
+def test_probe_marks_dead_replica_down_and_opens(router, stubs):
+    s = stubs("a")
+    ra = router.add_replica(s.url)
+    router.start()
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline and not ra.up:
+        time.sleep(0.02)
+    s.die()
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline and ra.breaker.state() != "open":
+        time.sleep(0.02)
+    assert not ra.up
+    assert ra.breaker.state() == "open"
+
+
+# ------------------------------------------------------------- metrics ------
+
+
+def test_fleet_metrics_in_unified_registry(front, stubs):
+    """One /metrics scrape on the router covers the fleet (ISSUE 9
+    satellite): pt_replica_up{replica=} per replica, breaker state,
+    routed/retried counters — and the exposition parses with the
+    strict promparse grammar."""
+    url, router = front
+    a, b = stubs("a", shed=True), stubs("b")
+    # the probe loop is live here: bias via the stub's REPORTED load so
+    # refreshes keep ra first (a hand-set snapshot would be overwritten)
+    b.srv.load = {"queue_depth": 50, "active_slots": 0}
+    router.add_replica(a.url, name="ra")
+    router.add_replica(b.url, name="rb")
+    rb = router._replicas["rb"]
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline \
+            and rb.snapshot.get("queue_depth") != 50:
+        time.sleep(0.02)
+    with _post(url, "/predict", {"inputs": {}}) as resp:
+        resp.read()
+    text = urllib.request.urlopen(url + "/metrics", timeout=5).read()
+    fams = promparse.parse_text(text.decode())
+    up = {lb["replica"]: v for _, lb, v in fams["pt_replica_up"].samples}
+    assert set(up) == {"ra", "rb"}
+    states = {lb["replica"]: v for _, lb, v in
+              fams["pt_replica_breaker_state"].samples}
+    assert set(states) == {"ra", "rb"}
+    routed = {lb["replica"]: v for _, lb, v in
+              fams["pt_router_routed_total"].samples}
+    assert routed["rb"] == 1 and routed["ra"] == 0
+    assert [v for _, _, v in
+            fams["pt_router_retried_total"].samples] == [1]
+
+
+def test_closed_router_removes_fleet_families(stubs):
+    r = Router()
+    r.add_replica(stubs("a").url)
+    reg = obs_metrics.registry()
+    assert "pt_replica_up" in reg.render()
+    r.close()
+    assert not any(ln.startswith("pt_replica_up")
+                   for ln in reg.render().splitlines())
+
+
+# ---------------------------------------------- lint: pick path is pure -----
+
+# calls that block on the network / clock have no business in the
+# replica-pick hot path: picking reads ONLY router-local state (breaker
+# admission, in-flight counters, probe-cached snapshots). The probe
+# loop and dispatch attempts own all I/O.
+_BLOCKING_CALLS = {
+    "urlopen", "request", "getresponse", "read", "readline", "recv",
+    "send", "sendall", "connect", "sleep", "wait", "join", "select",
+    "accept", "probe_one", "dispatch", "_attempt",
+}
+_BLOCKING_NAMES = {"HTTPConnection", "urlopen", "socket", "create_connection"}
+
+
+def _find_method(tree, cls, name):
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef) and node.name == cls:
+            for item in node.body:
+                if isinstance(item, ast.FunctionDef) and item.name == name:
+                    return item
+    return None
+
+
+def test_pick_hot_path_has_no_blocking_io():
+    """AST lint (the obs disarmed-path lint pattern): Router.pick,
+    Router._release and ReplicaClient.score must never perform
+    blocking I/O — a slow replica must not be able to stall the PICK
+    for traffic headed elsewhere."""
+    import paddle_tpu.serving.router as router_mod
+
+    path = router_mod.__file__
+    with open(path) as f:
+        tree = ast.parse(f.read())
+    checked = 0
+    for cls, meth in (("Router", "pick"), ("Router", "_release"),
+                      ("ReplicaClient", "score")):
+        fn = _find_method(tree, cls, meth)
+        assert fn is not None, f"{cls}.{meth} not found (lint is stale)"
+        checked += 1
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            f_ = node.func
+            called = (f_.attr if isinstance(f_, ast.Attribute)
+                      else f_.id if isinstance(f_, ast.Name) else None)
+            assert called not in _BLOCKING_CALLS, (
+                f"{cls}.{meth} calls blocking {called!r} in the "
+                "replica-pick hot path")
+            assert called not in _BLOCKING_NAMES, (
+                f"{cls}.{meth} constructs {called!r} in the "
+                "replica-pick hot path")
+    assert checked == 3

@@ -392,3 +392,138 @@ def test_http_shed_and_deadline(dense_model_dir):
     srv.server_close()
     assert codes["b"] == 503, codes
     assert codes["a"] == 504, codes
+
+
+# -------------------------------------------- ISSUE 9: fleet plumbing -------
+
+
+def test_healthz_reports_load_block(http_stack):
+    """/healthz carries the load block a join-shortest-queue router
+    scores replicas by: queue depth, slot occupancy, and the uniform
+    dispatch/sync counters — no /metrics scrape needed."""
+    reg, srv, url = http_stack
+    with urllib.request.urlopen(url + "/healthz", timeout=30) as r:
+        before = json.load(r)["load"]
+    for k in ("queue_depth", "active_slots", "max_slots",
+              "slot_occupancy", "dispatches_total", "syncs_total"):
+        assert k in before, before
+    _post(url + "/predict", {"inputs": {"x": [[0.1, 0.2, 0.3, 0.4]]}})
+    with urllib.request.urlopen(url + "/healthz", timeout=30) as r:
+        after = json.load(r)["load"]
+    assert after["dispatches_total"] > before["dispatches_total"]
+    assert after["syncs_total"] > before["syncs_total"]
+    assert after["queue_depth"] == 0  # nothing waiting at rest
+
+
+def test_predict_adopts_request_id_header(http_stack):
+    """The router-hop correlation satellite: a forwarded
+    X-PT-Request-Id is adopted for the /predict MicroBatcher path and
+    echoed on the response; absent the header, the replica mints one."""
+    from paddle_tpu.serving import REQUEST_ID_HEADER
+
+    reg, srv, url = http_stack
+    body = json.dumps(
+        {"inputs": {"x": [[0.1, 0.2, 0.3, 0.4]]}}).encode()
+    req = urllib.request.Request(
+        url + "/predict", data=body,
+        headers={"Content-Type": "application/json",
+                 REQUEST_ID_HEADER: "rt-777"})
+    with urllib.request.urlopen(req, timeout=60) as r:
+        assert r.headers.get(REQUEST_ID_HEADER) == "rt-777"
+        json.load(r)
+    req = urllib.request.Request(
+        url + "/predict", data=body,
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=60) as r:
+        minted = r.headers.get(REQUEST_ID_HEADER)
+        json.load(r)
+    assert minted  # replica minted its own
+
+
+def test_batcher_submit_adopts_request_id(dense_model_dir):
+    """Unit-level: MicroBatcher.submit(request_id=...) threads the id
+    into its _Request (the /predict path's correlation key; before
+    ISSUE 9 only the generation path carried caller-provided ids)."""
+    from paddle_tpu.serving.batcher import _Request
+
+    r = _Request({"x": np.zeros((1, 4), np.float32)}, deadline=1.0,
+                 request_id="rt-42")
+    assert r.request_id == "rt-42"
+    r2 = _Request({"x": np.zeros((1, 4), np.float32)}, deadline=1.0)
+    assert r2.request_id and r2.request_id != "rt-42"
+
+
+# -------------------------------------- ISSUE 9: mesh-sharded inference -----
+
+
+def _build_sharded_model(dirname: str) -> None:
+    """Vocab-sharded embedding (rows striped over `mp`) + fc head:
+    the partition spec must survive save→load via the meta.json
+    sharding sidecar."""
+    from paddle_tpu.parallel import sharded_embedding
+
+    pt.reset()
+    pt.default_startup_program().random_seed = 3
+    ids = pt.layers.data("ids", shape=[6], dtype="int64")
+    emb = sharded_embedding(ids, size=[32, 16])
+    h = pt.layers.fc(emb, size=8, act="tanh", num_flatten_dims=2)
+    out = pt.layers.fc(h, size=4, num_flatten_dims=2)
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    pt.io.save_inference_model(dirname, ["ids"], [out])
+
+
+def test_sharding_sidecar_roundtrip(tmp_path):
+    """save_inference_model records partition specs in meta.json;
+    load_inference_model re-attaches them to the restored vars."""
+    d = str(tmp_path / "sharded")
+    _build_sharded_model(d)
+    with open(d + "/meta.json") as f:
+        meta = json.load(f)
+    assert meta["sharding"]["mesh_axes"] == ["mp"]
+    (name, spec), = meta["sharding"]["specs"].items()
+    assert spec == ["mp", None]
+    prog, feeds, fetches = pt.io.load_inference_model(d, scope=pt.Scope())
+    from jax.sharding import PartitionSpec
+
+    v = prog.global_block().var(name)
+    assert v.sharding == PartitionSpec("mp", None)
+
+
+def test_mesh_replica_bit_identical_to_single_device(tmp_path):
+    """THE ISSUE 9 sharded-inference acceptance: the same artifact
+    served by a mesh replica (dp1,mp2 — embedding table striped over
+    2 devices) returns outputs BIT-identical to the single-device
+    engine, across batch buckets, including warmup."""
+    from paddle_tpu.parallel import mesh_from_spec
+
+    d = str(tmp_path / "sharded")
+    _build_sharded_model(d)
+    single = ServingEngine(d, policy=BucketPolicy(max_batch_size=4),
+                           model_name="one_chip")
+    mesh = mesh_from_spec("dp1,mp2")
+    meshed = ServingEngine(d, policy=BucketPolicy(max_batch_size=4),
+                           model_name="mesh", mesh=mesh)
+    assert meshed.warmup() == len(meshed.policy.batch_buckets)
+    rng = np.random.RandomState(5)
+    for n in (1, 2, 3, 4):
+        iv = rng.randint(0, 32, size=(n, 6)).astype(np.int64)
+        a = single.predict({"ids": iv})[0]
+        b = meshed.predict({"ids": iv})[0]
+        assert b.shape == (n, 6, 4)
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    s = meshed.stats()
+    assert s["mesh"]["axes"] == {"dp": 1, "mp": 2}
+    assert s["mesh"]["sharded_params"]
+
+
+def test_mesh_missing_axis_rejected(tmp_path):
+    """A serving mesh without the axes the artifact shards over must
+    fail loudly at load, not silently serve unsharded."""
+    from paddle_tpu.parallel import mesh_from_spec
+
+    d = str(tmp_path / "sharded")
+    _build_sharded_model(d)
+    with pytest.raises(ValueError, match="mp"):
+        ServingEngine(d, model_name="bad",
+                      mesh=mesh_from_spec("dp2"))
