@@ -255,6 +255,14 @@ define_flag("use_tuned_table", True,
             "machine without tuned entries (or any non-TPU backend) "
             "deterministically falls back to the analytic models; set 0 "
             "to ignore tables entirely (A/B escape hatch)")
+define_flag("tune_interpolate", True,
+            "on a tuned-table miss, fall through to the nearest tuned "
+            "entry for the same kernel/dtype/device by log-space shape "
+            "distance (Autotuner v2 shape interpolation), re-validated "
+            "against the target shape's legality model before use; the "
+            "consult is recorded as source=interpolated in "
+            "pt_tune_consults_total. Set 0 to restrict lookups to exact "
+            "shape signatures (A/B escape hatch)")
 define_flag("bn_bf16_stats", True,
             "batch_norm stats: square in the io dtype with f32 reduction "
             "accumulation instead of upcasting the activation first. "

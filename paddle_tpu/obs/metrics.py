@@ -348,6 +348,26 @@ def _trace_families():
     ]
 
 
+def _tune_families():
+    """Tuned-coverage of the live process: per-source consult counts
+    from the autotuner's one lookup point (tune/overrides.py). Every
+    source label renders from the first scrape (0 included), so
+    `paddle_tpu stats` on a fresh process already shows the full
+    forced/env/table/interpolated/analytic surface — the ratio of
+    table+interpolated to analytic IS the tuned-coverage number."""
+    import sys
+
+    overrides = sys.modules.get("paddle_tpu.tune.overrides")
+    if overrides is None:
+        return []
+    st = overrides.consult_stats()
+    return [
+        ("pt_tune_consults_total", "counter",
+         "tuned-config consults by provenance (tune/overrides.lookup)",
+         [({"source": s}, float(v)) for s, v in sorted(st.items())]),
+    ]
+
+
 def _statset_families():
     """The global StatSet rides the unified render even though it is
     not attach_stat_set'ed (reset_metrics would drop the attachment;
@@ -371,4 +391,5 @@ def _statset_families():
 
 _REGISTRY.add_collector(_faults_families)
 _REGISTRY.add_collector(_trace_families)
+_REGISTRY.add_collector(_tune_families)
 _REGISTRY.add_collector(_statset_families)

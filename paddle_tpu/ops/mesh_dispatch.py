@@ -77,6 +77,20 @@ def current() -> Optional[ActiveMesh]:
     return _ACTIVE.get()
 
 
+@contextlib.contextmanager
+def no_mesh():
+    """Clear the active-mesh context for code that is ALREADY running
+    per-shard (inside its own shard_map): eligibility there must see
+    the true local shapes, not divide them by dp a second time, and a
+    nested shard_batch wrap would be an error. parallel/ring_attention
+    brackets its per-shard inner attention with this."""
+    tok = _ACTIVE.set(None)
+    try:
+        yield
+    finally:
+        _ACTIVE.reset(tok)
+
+
 def dp_size() -> int:
     am = _ACTIVE.get()
     return am.dp if am is not None else 1

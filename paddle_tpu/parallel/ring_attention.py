@@ -109,8 +109,17 @@ def _ulysses_shard(q, k, v, axis_name: str, causal: bool):
     )
     # full-sequence attention per head subset: the fused flash kernel when
     # on TPU/eligible (O(T) memory — the point of sequence parallelism),
-    # the jnp reference elsewhere (ops/flash_ops.py dispatch)
-    o = flash_attention(q, k, v, causal=causal)
+    # the jnp reference elsewhere (ops/flash_ops.py dispatch). This body
+    # ALREADY runs per-shard inside shard_map, so the inner dispatch must
+    # see these exact local shapes: an ambient dp-mesh context (ulysses
+    # under a ParallelExecutor trace) would make _prefers_flash divide
+    # the batch by dp a SECOND time and flash_attention attempt a nested
+    # shard_map — the same per-shard eligibility discipline as the
+    # decoder/RNN kernels, applied one level down (ADVICE.md item 3).
+    from ..ops import mesh_dispatch
+
+    with mesh_dispatch.no_mesh():
+        o = flash_attention(q, k, v, causal=causal)
     # [B, T, H/n, D] -> [B, Tl, H, D]
     return jax.lax.all_to_all(o, axis_name, split_axis=1, concat_axis=2,
                               tiled=True)

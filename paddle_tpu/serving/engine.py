@@ -429,14 +429,86 @@ class ServingEngine:
         return self.scheduler().generate(feed, timeout_ms=timeout_ms)
 
     # ------------------------------------------------------------------
+    def tune_coverage(self) -> List[Dict[str, Any]]:
+        """Per-site tuned-coverage of everything THIS engine can
+        dispatch: the decode-step sites over the live bucket grid plus
+        any concrete-shape sites of the program, each classified the
+        way overrides.lookup would resolve it — "table" (exact local or
+        shipped-base entry), "interpolated" (+ the donor signature), or
+        "analytic" (untuned). Classification does not touch the
+        pt_tune_consults_total counters (overrides.classify)."""
+        from ..tune import cache as tune_cache
+        from ..tune import overrides as tune_overrides
+        from ..tune import space as tune_space
+
+        sites = list(self.decode_tune_cases())
+        try:
+            sites += tune_space.cases_from_program(self.program,
+                                                   dp=self._mesh_dp())
+        except (ValueError, KeyError):
+            pass
+        out, seen = [], set()
+        for c in sites:
+            try:
+                fam = tune_space.get_family(c["family"])
+                norm = fam.normalize(c["params"], c["dtype"])
+            except (KeyError, ValueError):
+                continue
+            key = (fam.name, tune_cache.make_sig(norm), c["dtype"])
+            if key in seen:
+                continue
+            seen.add(key)
+            source, origin = tune_overrides.classify(fam.name, norm,
+                                                     c["dtype"])
+            out.append({"family": fam.name, "sig": key[1],
+                        "dtype": c["dtype"], "source": source,
+                        **({"origin": origin} if origin else {})})
+        return out
+
+    def _coverage_detail(self) -> str:
+        """The actionable tail of the stale-table warning: WHICH
+        kernels/shapes will run untuned (analytic) vs interpolated, and
+        the exact `paddle_tpu tune` command that fixes it."""
+        cov = self.tune_coverage()
+        untuned = [c for c in cov if c["source"] == "analytic"]
+        interp = [c for c in cov if c["source"] == "interpolated"]
+        if not untuned and not interp:
+            return ""
+        lines = []
+        if untuned:
+            lines.append(
+                "untuned (analytic defaults): " + "; ".join(
+                    f"{c['family']}[{c['sig']} {c['dtype']}]"
+                    for c in untuned[:8])
+                + (f" (+{len(untuned) - 8} more)"
+                   if len(untuned) > 8 else ""))
+        if interp:
+            lines.append(
+                "interpolated from nearby shapes: " + "; ".join(
+                    f"{c['family']}[{c['sig']} <- {c.get('origin', '?')}]"
+                    for c in interp[:8])
+                + (f" (+{len(interp) - 8} more)"
+                   if len(interp) > 8 else ""))
+        lines.append(
+            "to tune them on this host: `paddle_tpu tune --config "
+            "<model.py>` for the training shapes, or per shape e.g. "
+            + "; ".join(
+                f"`paddle_tpu tune --kernel {c['family']} --shape "
+                f"{c['sig']} --dtype "
+                f"{'bf16' if c['dtype'] == 'bfloat16' else 'f32'}`"
+                for c in (untuned or interp)[:2]))
+        return "\n  " + "\n  ".join(lines)
+
     def check_tuned_table(self) -> bool:
         """Compare the model's recorded tuning provenance (exporter
         device_kind + tuned-table fingerprint, meta.json) against this
         process's table. A mismatch means the kernels the exporter
         measured are NOT what this host will dispatch — warn loudly
         (warmup calls this) instead of silently serving untuned/stale
-        configs. Returns True when provenance matches or the artifact
-        predates the tuner."""
+        configs, and NAME the affected kernels/shapes (untuned vs
+        interpolated) with the tune command that would fix them.
+        Returns True when provenance matches or the artifact predates
+        the tuner."""
         if not self.tuning_meta:
             return True  # pre-tuner artifact: nothing recorded
         from ..tune import cache as tune_cache
@@ -456,7 +528,7 @@ class ServingEngine:
             f"has table {cur_fp} on {cur_kind!r} — serving may run "
             "untuned or stale kernel configs (re-run `paddle_tpu tune` "
             "on this host and re-export, or ship the exporter's table "
-            "via PT_TUNE_CACHE)", stacklevel=2)
+            "via PT_TUNE_CACHE)" + self._coverage_detail(), stacklevel=2)
         return False
 
     def _zero_bucket_feed(self, nb: int, tb: Optional[int]):
@@ -516,20 +588,33 @@ class ServingEngine:
         return compiled
 
     # -- decode-step kernel tuning (ROADMAP 4c slice) -------------------
+    def _mesh_dp(self) -> int:
+        """The serving mesh's data-parallel degree (1 off-mesh): the
+        fused kernels dispatch inside shard_map at the PER-SHARD batch
+        (ops/mesh_dispatch.local_batch), so every tuning consult this
+        engine derives must key on bucket/dp — a global-batch entry
+        would tune a shape that never dispatches (ADVICE.md's per-shard
+        eligibility lesson, applied to tuning)."""
+        if self.mesh is None or self.batch_axis is None:
+            return 1
+        return int(self.mesh.shape.get(self.batch_axis, 1))
+
     def decode_tune_cases(self) -> List[Dict[str, Any]]:
         """Tunable kernel sites of the decode step, expanded over the
         live batch-bucket grid: the decode-step batch is
-        (bucket x beam_size) rows, a shape the offline `tune --config`
-        sweep cannot know (it sees -1 batch dims). Covers bahdanau
-        attention-GRU sites (both the fused train-side op and the
-        beam-search monolith) and static-shape flash_attention sites in
-        any block."""
+        (bucket x beam_size) rows — divided by the mesh's dp degree
+        when this replica serves sharded — a shape the offline
+        `tune --config` sweep cannot know (it sees -1 batch dims).
+        Covers bahdanau attention-GRU sites (both the fused train-side
+        op and the beam-search monolith) and static-shape
+        flash_attention sites in any block."""
         from ..tune.space import pad_s
 
         spec = self._gen_spec
         amp = "bfloat16" if getattr(self.program, "amp_dtype", None) \
             else "float32"
         out: List[Dict[str, Any]] = []
+        dp = self._mesh_dp()
 
         def var_shape(block, name):
             try:
@@ -550,9 +635,12 @@ class ServingEngine:
                     kk = int(op.attrs.get("beam_size", K)) \
                         if op.type == "attention_gru_beam_search" else K
                     for nb in self.policy.batch_buckets:
+                        if nb % dp:
+                            continue  # ragged shard: runtime scans
                         out.append({
                             "family": "bahdanau_attention",
-                            "params": {"B": nb * kk, "Sp": pad_s(src),
+                            "params": {"B": (nb // dp) * kk,
+                                       "Sp": pad_s(src),
                                        "A": wa[1], "C": enc[-1]},
                             "dtype": amp, "op": op.type})
                 elif op.type == "flash_attention":

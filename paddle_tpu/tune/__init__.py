@@ -26,26 +26,47 @@ Module layout:
                cross-check against the reference lowering. REFUSES to
                time on non-TPU backends (a CPU timing would poison the
                per-device table) — lookups then fall back to analytic
-               defaults deterministically.
+               defaults deterministically. The timing oracle is
+               INJECTABLE (make_oracle builds the real one), so the
+               search quality is testable on recorded timings in the
+               CPU suite.
+  search.py    Autotuner v2's guided searcher: a lightweight cost model
+               (HBM traffic + grid overhead + VMEM-pressure features
+               from space.py's legality model) ranks candidates, and
+               successive halving with early stop times only the
+               top-ranked fraction — >= 95% of exhaustive quality at
+               <= 40% of the space (tests + bench tune_search).
   cache.py     the persistent JSON table keyed by (kernel,
                shape-signature, dtype, device_kind): atomic writes,
                schema versioning, corrupt-file recovery, an in-process
-               LRU front.
+               LRU front. Also the fleet EXCHANGE format: entry meta
+               carries provenance (measured/interpolated) + updated_at,
+               and merge_entry resolves conflicts measured-first,
+               newest-second (tune export/import/merge CLI).
   overrides.py the one consult point kernels call at trace time:
                forced override (programmatic or env, e.g. PT_ATTN_BBLK)
-               -> tuned table -> None (analytic default). Also exports
-               the fingerprint the Executor folds into its jit cache
-               key, so flipping ANY kernel knob re-traces instead of
-               silently reusing a stale tile choice.
+               -> exact table (local, then the pre-tuned base table the
+               package ships per device_kind under tune/tables/) ->
+               nearest-shape interpolation re-validated against the
+               target's legality -> None (analytic default). Records
+               per-source consult counts (pt_tune_consults_total) and
+               exports the fingerprint the Executor folds into its jit
+               cache key, so flipping ANY kernel knob re-traces instead
+               of silently reusing a stale tile choice.
 
 CLI: `python -m paddle_tpu tune --kernel bahdanau --shape B=256,S=60,\
-A=512,C=512 [--dry-run]` — see cli.py.
+A=512,C=512 [--dry-run] [--search guided|exhaustive]`, plus
+`tune export/import/merge` for moving tables between fleet hosts —
+see cli.py.
 """
 
 from . import cache  # noqa: F401
 from . import space  # noqa: F401
 from . import overrides  # noqa: F401
 from . import harness  # noqa: F401
+from . import search  # noqa: F401
 from .cache import TunedTable, device_kind  # noqa: F401
-from .harness import TuningUnavailable, tune_case  # noqa: F401
+from .harness import TuningUnavailable, make_oracle, tune_case  # noqa: F401
 from .overrides import force, forcing, lookup  # noqa: F401
+from .search import (SimulatedOracle, guided_search,  # noqa: F401
+                     predicted_cost, rank_candidates)

@@ -9,13 +9,28 @@ runtime then uses its analytic default — the same code path as an
 untuned machine, so shipping a table can never CHANGE behavior on
 hardware it wasn't measured on).
 
+Fleet sharing (Autotuner v2): the same file format is the EXCHANGE
+format — `paddle_tpu tune export/import/merge` move tables between
+hosts, and pre-tuned per-device tables ship with the package under
+`paddle_tpu/tune/tables/<device_kind>.json` (auto-consulted as a
+read-through base layer beneath the user's local table; see
+tune/overrides.py). To make merging well-defined, every entry's meta
+carries its PROVENANCE ("measured" from the timing harness,
+"interpolated" from a nearest-shape materialization) and an
+`updated_at` epoch stamp; `merge_entry` resolves conflicts as
+measured-beats-interpolated first, newest-wins second — a fleet member
+can therefore blindly merge a colleague's table without ever letting a
+guessed config shadow a measured one.
+
 Durability discipline:
 - writes are atomic (tempfile in the target dir + os.replace), so a
   killed tune run can't leave a half-written table for every later
   process to choke on;
 - the file carries a schema version; a version mismatch is ignored with
   a warning (forward-compat: an old runtime reading a new table must
-  fall back to analytic defaults, not crash);
+  fall back to analytic defaults, not crash) — `tune import` REJECTS
+  it loudly instead (an operator merging tables wants the error, not a
+  silent no-op);
 - a corrupt file (truncated, hand-edited, wrong types) is moved aside
   to `<path>.corrupt` and an empty table takes its place — the tuner
   must never be able to break model execution;
@@ -30,11 +45,19 @@ import hashlib
 import json
 import os
 import tempfile
+import time
 import warnings
-from typing import Any, Dict, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 TABLE_VERSION = 1
 _LRU_CAP = 512
+
+# entry provenance vocabulary (meta["provenance"]): measured entries
+# come from the timing harness, interpolated ones from a materialized
+# nearest-shape match. Unknown/missing provenance merges as weakest.
+MEASURED = "measured"
+INTERPOLATED = "interpolated"
+_PROVENANCE_RANK = {MEASURED: 2, INTERPOLATED: 1}
 
 # itemsize -> dtype name for kernels whose shape model only sees the io
 # itemsize (bahdanau _bblk, the RNN eligibility): the fused families
@@ -70,6 +93,52 @@ def entry_key(kernel: str, sig: str, dtype: str, device: str) -> str:
     return "|".join((kernel, sig, dtype, device))
 
 
+def parse_key(key: str) -> Optional[Tuple[str, str, str, str]]:
+    """entry_key inverse: (kernel, sig, dtype, device), or None for a
+    malformed key (hand-edited tables must degrade, not crash)."""
+    parts = key.split("|")
+    if len(parts) != 4:
+        return None
+    return parts[0], parts[1], parts[2], parts[3]
+
+
+def sig_to_params(sig: str) -> Optional[Dict[str, int]]:
+    """Shape signature back to its params dict (int-valued keys only —
+    exactly what make_sig emits for the kernel families)."""
+    if not sig:
+        return None
+    out: Dict[str, int] = {}
+    for kv in sig.split(","):
+        k, eq, v = kv.partition("=")
+        if not eq:
+            return None
+        try:
+            out[k] = int(v)
+        except ValueError:
+            return None
+    return out
+
+
+def merge_entry(mine: Optional[Dict[str, Any]],
+                theirs: Dict[str, Any]) -> Dict[str, Any]:
+    """Conflict resolution for one key: measured beats interpolated,
+    then newest `updated_at` wins (a fresh re-measurement supersedes an
+    old one; ties keep the incumbent — merging a table into itself is a
+    no-op). Entries without provenance/updated_at rank weakest/oldest,
+    so a modern entry always survives a legacy one."""
+    if mine is None:
+        return theirs
+    rank_m = _PROVENANCE_RANK.get(
+        (mine.get("meta") or {}).get("provenance"), 0)
+    rank_t = _PROVENANCE_RANK.get(
+        (theirs.get("meta") or {}).get("provenance"), 0)
+    if rank_t != rank_m:
+        return theirs if rank_t > rank_m else mine
+    at_m = float((mine.get("meta") or {}).get("updated_at", 0) or 0)
+    at_t = float((theirs.get("meta") or {}).get("updated_at", 0) or 0)
+    return theirs if at_t > at_m else mine
+
+
 class TunedTable:
     """entries: key -> {"config": {...}, "meta": {...}}."""
 
@@ -102,17 +171,68 @@ class TunedTable:
 
     def put(self, kernel: str, params: Dict[str, Any], dtype: str,
             config: Dict[str, Any], device: Optional[str] = None,
-            meta: Optional[Dict[str, Any]] = None) -> str:
+            meta: Optional[Dict[str, Any]] = None,
+            provenance: Optional[str] = None) -> str:
         key = entry_key(kernel, make_sig(params), dtype,
                         device if device is not None else device_kind())
-        self.entries[key] = {"config": dict(config),
-                             "meta": dict(meta or {})}
+        m = dict(meta or {})
+        if provenance is not None:
+            m["provenance"] = provenance
+            m.setdefault("updated_at", int(time.time()))
+        self.entries[key] = {"config": dict(config), "meta": m}
         self._lru.pop(key, None)
         self._fp = None
         return key
 
     def __len__(self) -> int:
         return len(self.entries)
+
+    def entries_for(self, kernel: str, dtype: str,
+                    device: Optional[str] = None
+                    ) -> List[Tuple[Dict[str, int], Dict[str, Any],
+                                    Dict[str, Any]]]:
+        """All (params, config, meta) tuned for this kernel/dtype/device
+        — the interpolation neighbor pool (tune/overrides.py). Malformed
+        keys/signatures are skipped, never fatal."""
+        device = device if device is not None else device_kind()
+        out = []
+        for key, e in self.entries.items():
+            parsed = parse_key(key)
+            if parsed is None:
+                continue
+            k, sig, dt, dev = parsed
+            if k != kernel or dt != dtype or dev != device:
+                continue
+            params = sig_to_params(sig)
+            if params is None or not isinstance(e.get("config"), dict):
+                continue
+            out.append((params, dict(e["config"]),
+                        dict(e.get("meta") or {})))
+        return out
+
+    def merge_from(self, other: "TunedTable") -> Dict[str, int]:
+        """Merge `other`'s entries into this table under the
+        measured-beats-interpolated / newest-wins policy. Returns
+        {"added", "replaced", "kept"} counts for the CLI report."""
+        stats = {"added": 0, "replaced": 0, "kept": 0}
+        for key, theirs in other.entries.items():
+            if not isinstance(theirs, dict) \
+                    or not isinstance(theirs.get("config"), dict):
+                continue
+            mine = self.entries.get(key)
+            winner = merge_entry(mine, theirs)
+            if mine is None:
+                stats["added"] += 1
+            elif winner is theirs:
+                stats["replaced"] += 1
+            else:
+                stats["kept"] += 1
+                continue
+            self.entries[key] = {"config": dict(theirs["config"]),
+                                 "meta": dict(theirs.get("meta") or {})}
+            self._lru.pop(key, None)
+            self._fp = None
+        return stats
 
     def fingerprint(self) -> str:
         """Content hash over the entry set — folded into the Executor's
@@ -185,6 +305,40 @@ class TunedTable:
         return path
 
 
+class TableFormatError(ValueError):
+    """A table file that must not be silently ignored (tune import /
+    merge): wrong schema version, malformed JSON, bad entry shape."""
+
+
+def load_strict(path: str) -> TunedTable:
+    """Load a table for import/merge: unlike TunedTable.load (runtime
+    read-path, degrades to empty with a warning), this RAISES
+    TableFormatError on schema-version mismatch or corruption — an
+    operator moving tables between hosts wants the loud failure."""
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except OSError as e:
+        raise TableFormatError(f"cannot read table {path}: {e}") from e
+    except json.JSONDecodeError as e:
+        raise TableFormatError(f"table {path} is not JSON: {e}") from e
+    if not isinstance(doc, dict):
+        raise TableFormatError(f"table {path}: root must be an object")
+    if doc.get("version") != TABLE_VERSION:
+        raise TableFormatError(
+            f"table {path} has schema version {doc.get('version')!r}; "
+            f"this build reads version {TABLE_VERSION} — re-export it "
+            "from a matching build")
+    entries = doc.get("entries", {})
+    if not isinstance(entries, dict) or not all(
+            isinstance(e, dict) and isinstance(e.get("config"), dict)
+            for e in entries.values()):
+        raise TableFormatError(f"table {path}: malformed entries")
+    t = TunedTable(path, autoload=False)
+    t.entries = entries
+    return t
+
+
 def default_path() -> str:
     """PT_TUNE_CACHE env, else the XDG-ish per-user location."""
     env = os.environ.get("PT_TUNE_CACHE")
@@ -193,3 +347,25 @@ def default_path() -> str:
     base = os.environ.get("XDG_CACHE_HOME",
                           os.path.join(os.path.expanduser("~"), ".cache"))
     return os.path.join(base, "paddle_tpu", "tuned.json")
+
+
+def base_table_dir() -> str:
+    """Where the pre-tuned fleet tables live: PT_TUNE_TABLES_DIR env
+    (tests point it at a tmpdir; empty string disables the base layer
+    entirely), else the package's shipped `tune/tables/` directory."""
+    env = os.environ.get("PT_TUNE_TABLES_DIR")
+    if env is not None:
+        return env
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "tables")
+
+
+def base_table_path(device: Optional[str] = None) -> Optional[str]:
+    """The shipped table for this device kind, or None when the package
+    carries none (every non-TPU dev box): `tables/<device_kind>.json`,
+    device_kind already filename-safe (lowercased, '-'-joined)."""
+    d = base_table_dir()
+    if not d:
+        return None
+    path = os.path.join(d, f"{device or device_kind()}.json")
+    return path if os.path.exists(path) else None

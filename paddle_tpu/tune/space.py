@@ -419,16 +419,50 @@ def get_family(name: str) -> KernelSpace:
     return FAMILIES[key]
 
 
+def config_legal(family: str, params: Params, dtype: str,
+                 config: Config) -> bool:
+    """Is `config` a legal candidate for `params` — i.e. would the
+    candidate generator itself have emitted it? THE re-validation gate
+    for shape-interpolated lookups (tune/overrides.py): a config tuned
+    at a NEIGHBORING shape is only usable at the target shape if it is
+    inside the target's own candidate set, so an interpolated consult
+    can never hand the runtime a tile its legality model rejects.
+    Membership (not just predicate re-evaluation) is deliberate: the
+    generators encode extra structure — divisor grids, the fixed block
+    lists — that a bare predicate check would miss. Malformed
+    params/config degrade to False, never raise (interpolation feeds
+    arbitrary table contents through here)."""
+    try:
+        fam = get_family(family)
+        norm = fam.normalize(params, dtype)
+        return dict(config) in fam.candidates(norm)
+    except (KeyError, ValueError, TypeError):
+        return False
+
+
 # ------------------------------------------------- model program sweep --
-def cases_from_program(program=None) -> List[Dict[str, Any]]:
+def cases_from_program(program=None, dp: int = 1) -> List[Dict[str, Any]]:
     """Best-effort scan of a Program for tunable kernel sites with
     concrete shapes: returns [{family, params, dtype, op}] — the CLI's
     `tune --config model.py` sweep source. Sites whose shapes aren't
     fully concrete (e.g. -1 batch) are skipped; the per-kernel
-    `--kernel/--shape` path covers those."""
+    `--kernel/--shape` path covers those.
+
+    `dp` is the data-parallel degree the model will RUN under: the
+    fused kernels dispatch inside shard_map at the PER-SHARD batch
+    (ops/mesh_dispatch.local_batch — ADVICE.md's per-shard eligibility
+    lesson), so tuning must key on the per-shard shape too, or every
+    mesh run misses the table and a global-batch entry tunes a shape
+    that never dispatches. Batch-carrying params divide by dp;
+    non-divisible sites are skipped (the runtime falls back to the
+    scan/XLA formulation there — nothing to tune). The fused-conv
+    kernel is not mesh-wrapped at all (mesh_dispatch docstring), so its
+    sites are skipped entirely under dp > 1."""
     from ..core.program import default_main_program
 
     program = program or default_main_program()
+    if dp < 1:
+        raise ValueError(f"dp must be >= 1, got {dp}")
     amp_dt = "bfloat16" if getattr(program, "amp_dtype", None) else "float32"
     out = []
 
@@ -451,6 +485,8 @@ def cases_from_program(program=None) -> List[Dict[str, Any]]:
                             "params": {"Tq": s[1], "Tk": k[1]},
                             "dtype": amp_dt, "op": op.type})
             elif op.type == "fused_conv_bn":
+                if dp > 1:
+                    continue  # not mesh-wrapped: falls back under a mesh
                 s = var_shape(block, op.inputs["X"][0])
                 w = var_shape(block, op.inputs["Filter"][0])
                 if not s or not w or len(s) != 4 or min(s) <= 0:
@@ -467,11 +503,13 @@ def cases_from_program(program=None) -> List[Dict[str, Any]]:
                 h0 = var_shape(block, op.inputs["H0"][0])
                 if not enc or not wa or not h0 or h0[0] <= 0:
                     continue
+                if h0[0] % dp:
+                    continue  # ragged shard: runtime scans, nothing to tune
                 src = int(op.attrs.get("src_max_len") or 0)
                 if src <= 0:
                     continue
                 out.append({"family": "bahdanau_attention",
-                            "params": {"B": h0[0], "Sp": pad_s(src),
+                            "params": {"B": h0[0] // dp, "Sp": pad_s(src),
                                        "A": wa[1], "C": enc[-1]},
                             "dtype": amp_dt, "op": op.type})
             # dynamic_lstm/dynamic_gru sites are LoD-batched: their

@@ -30,11 +30,112 @@ jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_default_matmul_precision", "highest")
 
 import faulthandler  # noqa: E402
+import functools  # noqa: E402
 
 import pytest  # noqa: E402
 
+# ---------------------------------------------------------------------------
+# Environment capability probes (probed ONCE here; tests opt in via the
+# `needs_shard_map` / `needs_cpu_multiprocess` markers and are reported
+# as environment SKIPS — not failures — where the capability is absent.
+# On platforms where the APIs exist the marked tests run unchanged.)
+# ---------------------------------------------------------------------------
+
+# jax.shard_map was promoted to the top-level namespace in newer jax;
+# this container's build only has the experimental module, and the
+# repo's mesh policy (ops/mesh_dispatch, parallel/collective,
+# parallel/ring_attention) targets the documented top-level API — the
+# long-standing "22 shard_map failures" of CHANGES.md are exactly this.
+HAS_SHARD_MAP = hasattr(jax, "shard_map")
+
+_MP_PROBE_CHILD = r"""
+import os
+import numpy as np
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.distributed.initialize(
+    coordinator_address=os.environ["PT_PROBE_COORD"],
+    num_processes=2, process_id=int(os.environ["PT_PROBE_PID"]))
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+devs = jax.devices()
+assert len(devs) == 2, devs
+mesh = Mesh(np.array(devs), ("dp",))
+sh = NamedSharding(mesh, P("dp"))
+x = jax.make_array_from_process_local_data(sh, np.ones((1,), np.float32))
+s = jax.jit(lambda a: jnp.sum(a))(x)  # needs a cross-process collective
+assert float(s) == 2.0, s
+print("probe ok", flush=True)
+"""
+
+
+@functools.lru_cache(maxsize=1)
+def cpu_multiprocess_ok() -> bool:
+    """Can two localhost CPU processes form a jax.distributed pair and
+    run one cross-process collective? This jaxlib's CPU backend raises
+    'Multiprocess computations aren't implemented' at dispatch, which
+    is only observable by actually doing it — so the probe is a minimal
+    2-process psum, run at most once per session (lru_cache) and only
+    when a `needs_cpu_multiprocess` test was collected."""
+    import socket
+    import subprocess
+    import sys
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    procs = []
+    for pid in range(2):
+        env = dict(os.environ)
+        env.update(PT_PROBE_COORD=f"127.0.0.1:{port}",
+                   PT_PROBE_PID=str(pid), JAX_PLATFORMS="cpu",
+                   XLA_FLAGS="--xla_force_host_platform_device_count=1")
+        env.pop("JAX_NUM_CPU_DEVICES", None)
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c", _MP_PROBE_CHILD], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
+    ok = True
+    for p in procs:
+        try:
+            p.communicate(timeout=120)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+                q.communicate()
+            return False
+        ok = ok and p.returncode == 0
+    return ok
+
+
+def pytest_collection_modifyitems(config, items):
+    skip_sm = pytest.mark.skip(
+        reason="environment: this jax build has no jax.shard_map "
+               "(top-level API); mesh kernel dispatch cannot run")
+    need_mp = [it for it in items
+               if it.get_closest_marker("needs_cpu_multiprocess")]
+    mp_ok = cpu_multiprocess_ok() if need_mp else True
+    skip_mp = pytest.mark.skip(
+        reason="environment: this jaxlib's CPU backend does not "
+               "implement multiprocess computations (probed once by "
+               "conftest.cpu_multiprocess_ok)")
+    for it in items:
+        if not HAS_SHARD_MAP and it.get_closest_marker("needs_shard_map"):
+            it.add_marker(skip_sm)
+        if not mp_ok and it.get_closest_marker("needs_cpu_multiprocess"):
+            it.add_marker(skip_mp)
+
 
 def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "needs_shard_map: requires the top-level jax.shard_map API; "
+        "skipped (environment, not failure) on jax builds without it")
+    config.addinivalue_line(
+        "markers",
+        "needs_cpu_multiprocess: requires multiprocess computations on "
+        "the CPU backend (2-process jax.distributed collectives); "
+        "probed once per session, skipped where unimplemented")
     config.addinivalue_line(
         "markers",
         "slow: long-running; excluded from the tier-1 run (-m 'not slow')")

@@ -254,3 +254,90 @@ def get_model():
     assert r.returncode == 0, r.stderr[-2000:]
     assert "kernel flash_attention" in r.stdout
     assert "Tk=1024,Tq=1024" in r.stdout
+
+
+def _seed_table(path, entries):
+    """Write a tuned table via the real TunedTable (keys/format stay in
+    sync with the runtime by construction)."""
+    from paddle_tpu.tune.cache import TunedTable
+
+    t = TunedTable(str(path), autoload=False)
+    for fam, params, dtype, cfg, meta in entries:
+        t.put(fam, params, dtype, cfg, device="tpu-v5-lite", meta=meta)
+    t.save()
+    return t.fingerprint()
+
+
+def test_cli_tune_export_import_merge_round_trip(tmp_path):
+    """The fleet workflow end to end: host A exports, host B imports
+    into its local table (precedence applied), a merge job aggregates —
+    and export -> import -> export is bit-identical."""
+    a = tmp_path / "hostA.json"
+    _seed_table(a, [
+        ("bahdanau_attention", {"B": 256, "Sp": 64, "A": 512, "C": 512},
+         "bfloat16", {"bblk": 8},
+         {"provenance": "measured", "updated_at": 100}),
+        ("flash_attention", {"Tq": 2048, "Tk": 2048}, "bfloat16",
+         {"block_q": 512, "block_k": 512},
+         {"provenance": "interpolated", "updated_at": 100}),
+    ])
+    exp = tmp_path / "export.json"
+    r = _run(["tune", "export", "--out", str(exp), "--cache", str(a)],
+             str(tmp_path))
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "exported 2 entries" in r.stdout
+
+    # host B: older interpolated bahdanau (loses), MEASURED flash (wins
+    # over A's interpolated despite being older)
+    b = tmp_path / "hostB.json"
+    _seed_table(b, [
+        ("bahdanau_attention", {"B": 256, "Sp": 64, "A": 512, "C": 512},
+         "bfloat16", {"bblk": 16},
+         {"provenance": "interpolated", "updated_at": 999}),
+        ("flash_attention", {"Tq": 2048, "Tk": 2048}, "bfloat16",
+         {"block_q": 1024, "block_k": 1024},
+         {"provenance": "measured", "updated_at": 50}),
+    ])
+    r = _run(["tune", "import", str(exp), "--cache", str(b)],
+             str(tmp_path))
+    assert r.returncode == 0, r.stderr[-2000:]
+    from paddle_tpu.tune.cache import TunedTable
+
+    merged = TunedTable(str(b))
+    assert merged.get("bahdanau_attention",
+                      {"B": 256, "Sp": 64, "A": 512, "C": 512},
+                      "bfloat16", device="tpu-v5-lite") == {"bblk": 8}
+    assert merged.get("flash_attention", {"Tq": 2048, "Tk": 2048},
+                      "bfloat16", device="tpu-v5-lite") == {
+        "block_q": 1024, "block_k": 1024}
+
+    # bit-identical round trip: import the export into an EMPTY local
+    # table and re-export
+    empty = tmp_path / "empty.json"
+    r = _run(["tune", "import", str(exp), "--cache", str(empty)],
+             str(tmp_path))
+    assert r.returncode == 0, r.stderr[-2000:]
+    exp2 = tmp_path / "export2.json"
+    r = _run(["tune", "export", "--out", str(exp2), "--cache",
+              str(empty)], str(tmp_path))
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert exp.read_bytes() == exp2.read_bytes()
+
+    # merge: N inputs -> one output, without touching any local table
+    out = tmp_path / "fleet.json"
+    r = _run(["tune", "merge", "--out", str(out), str(a), str(b)],
+             str(tmp_path))
+    assert r.returncode == 0, r.stderr[-2000:]
+    fleet = TunedTable(str(out))
+    assert len(fleet) == 2
+    assert fleet.get("flash_attention", {"Tq": 2048, "Tk": 2048},
+                     "bfloat16", device="tpu-v5-lite") == {
+        "block_q": 1024, "block_k": 1024}
+
+
+def test_cli_tune_import_rejects_schema_mismatch(tmp_path):
+    bad = tmp_path / "bad.json"
+    bad.write_text('{"version": 999, "entries": {}}')
+    r = _run(["tune", "import", str(bad)], str(tmp_path))
+    assert r.returncode != 0
+    assert "schema version" in (r.stderr + r.stdout)

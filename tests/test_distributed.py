@@ -73,6 +73,7 @@ def _free_port():
     return port
 
 
+@pytest.mark.needs_cpu_multiprocess
 def test_two_process_data_parallel_grads(tmp_path):
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     port = _free_port()

@@ -141,6 +141,7 @@ def _run_job(mode, ckpt_dir, out_file, repo):
         assert p.returncode == 0, f"{mode} child failed:\n{out}"
 
 
+@pytest.mark.needs_cpu_multiprocess
 def test_two_process_sharded_checkpoint_resume(tmp_path):
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     ckpt = str(tmp_path / "ckpt")

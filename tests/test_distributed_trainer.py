@@ -15,6 +15,7 @@ import subprocess
 import sys
 
 import numpy as np
+import pytest
 
 _CHILD = r"""
 import os, sys
@@ -129,6 +130,7 @@ def _run_job(passes, ckpt_dir, out_file, repo, expect_start_pass=None):
         assert p.returncode == 0, f"child failed:\n{out}"
 
 
+@pytest.mark.needs_cpu_multiprocess
 def test_two_process_trainer_with_checkpoint_resume(tmp_path):
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
@@ -178,6 +180,7 @@ print(f"proc {jax.process_index()} seedless loss={float(np.asarray(l)):.6f}",
 """
 
 
+@pytest.mark.needs_cpu_multiprocess
 def test_seedless_startup_on_parallel_executor(tmp_path):
     """Regression (code review): exe.run(startup) directly on a
     ParallelExecutor, with NO random_seed set, must work across

@@ -101,6 +101,7 @@ def _train_lstm(mesh, steps=3, hidden=512, fused=False):
         FLAGS.use_fused_rnn = True
 
 
+@pytest.mark.needs_shard_map
 def test_fused_lstm_dp8_matches_single_device(fused_interpret):
     """dp8 mesh + fused LSTM kernels (in-window H=512) == single-device
     run of the SAME fused kernels, through training steps — isolates
@@ -117,6 +118,7 @@ def test_fused_lstm_dp8_matches_single_device(fused_interpret):
     np.testing.assert_allclose(par_w, ref_w, rtol=5e-3, atol=5e-3)
 
 
+@pytest.mark.needs_shard_map
 def test_fused_lstm_dp8_matches_scan_one_step(fused_interpret):
     """One step (before optimizer-state feedback compounds rounding):
     dp8 mesh + fused kernels matches the single-device XLA SCAN — the
@@ -127,6 +129,7 @@ def test_fused_lstm_dp8_matches_scan_one_step(fused_interpret):
     np.testing.assert_allclose(par_losses, ref_losses, rtol=2e-4, atol=2e-4)
 
 
+@pytest.mark.needs_shard_map
 def test_fused_lstm_dp_mp_mesh(fused_interpret):
     """Same equivalence under a 2-axis (dp4, mp2) mesh — the fused
     kernels shard over dp and replicate over mp."""
@@ -181,6 +184,7 @@ def _train_nmt(mesh, steps=3, fused=False):
         FLAGS.use_fused_attention = True
 
 
+@pytest.mark.needs_shard_map
 def test_fused_decoder_dp2_matches_single_device(fused_interpret):
     """dp2 mesh + fused Bahdanau decoder == single-device fused decoder
     through training (psum'd dWx/dWh/dv/dWaDec/dbias correct), plus a
@@ -198,6 +202,7 @@ def test_fused_decoder_dp2_matches_single_device(fused_interpret):
                                rtol=5e-4, atol=5e-4)
 
 
+@pytest.mark.needs_shard_map
 def test_bench_geometry_dispatches_fused_under_mesh(fused_interpret):
     """The bench-default NMT geometry (bs256, S=T=50, H=512, C=1024,
     bf16) keeps the FUSED path under a dp4 mesh: per-shard batch 64 is
@@ -260,6 +265,7 @@ def test_fused_lstm_dp1_mesh(fused_interpret):
     assert np.isfinite(losses).all() and losses[1] < losses[0], losses
 
 
+@pytest.mark.needs_shard_map
 def test_flash_attention_shard_maps_under_dp_mesh(monkeypatch):
     """The flash dispatcher wraps its kernel in shard_map under a dp
     mesh (kernel monkeypatched to the jnp reference — the real Mosaic

@@ -482,3 +482,458 @@ def test_cases_from_program_finds_flash_sites():
     sites = space.cases_from_program()
     flash = [s for s in sites if s["family"] == "flash_attention"]
     assert flash and flash[0]["params"] == {"Tq": 1024, "Tk": 1024}
+
+
+def _build_decoder_program(B=16, C=32, A=24, S=8):
+    enc = pt.layers.data("enc", shape=[B, S, C], append_batch_size=False,
+                         lod_level=1)
+    trg = pt.layers.data("trg", shape=[B, 6], append_batch_size=False,
+                         lod_level=1)
+    boot = pt.layers.data("boot", shape=[B, A], append_batch_size=False)
+    pt.layers.attention_gru_decoder(enc, trg, boot, size=A,
+                                    src_max_len=S, trg_max_len=S)
+
+
+def test_cases_from_program_mesh_local_batch():
+    """ISSUE-10 tentpole (d): under a dp mesh the fused kernels
+    dispatch at the PER-SHARD batch (mesh_dispatch.local_batch), so the
+    sweep must key tuning cases on B/dp — and skip sites dp does not
+    divide (the runtime scans there; a global-batch entry would tune a
+    shape that never dispatches)."""
+    _build_decoder_program(B=16)
+    bah = [s for s in space.cases_from_program()
+           if s["family"] == "bahdanau_attention"]
+    assert bah and bah[0]["params"]["B"] == 16
+    bah4 = [s for s in space.cases_from_program(dp=4)
+            if s["family"] == "bahdanau_attention"]
+    assert bah4 and bah4[0]["params"]["B"] == 4
+    # everything but the batch is shard-invariant
+    assert {k: v for k, v in bah4[0]["params"].items() if k != "B"} == \
+        {k: v for k, v in bah[0]["params"].items() if k != "B"}
+    # non-divisible dp: the site is skipped, not mis-keyed
+    assert not [s for s in space.cases_from_program(dp=3)
+                if s["family"] == "bahdanau_attention"]
+    # flash keys on sequence lengths only — dp leaves it untouched
+    pt.reset()
+    q = pt.layers.data("q", shape=[1024, 256])
+    pt.layers.multi_head_attention(q, num_heads=2, causal=False)
+    f1 = [s for s in space.cases_from_program()
+          if s["family"] == "flash_attention"]
+    f4 = [s for s in space.cases_from_program(dp=4)
+          if s["family"] == "flash_attention"]
+    assert f1 and [s["params"] for s in f1] == [s["params"] for s in f4]
+
+
+# ===================================================== Autotuner v2 ======
+# -------------------------------------------------- shape interpolation --
+def _put_cpu(t, fam, params, dtype, cfg, **meta_kw):
+    t.put(fam, params, dtype, cfg, **meta_kw)
+
+
+def test_consult_order_forced_env_exact_interpolated_analytic(
+        tmp_table, monkeypatch):
+    """THE v2 precedence chain, one layer peeled off at a time."""
+    params = {"B": 16, "Sp": 16, "A": 128, "C": 128}
+    near = {"B": 32, "Sp": 16, "A": 128, "C": 128}
+    t = overrides.table()
+    t.put("bahdanau_attention", near, "float32", {"bblk": 8})
+    t.put("bahdanau_attention", params, "float32", {"bblk": 16})
+    monkeypatch.setenv("PT_ATTN_BBLK", "4")
+    with overrides.forcing("bahdanau_attention", {"bblk": 2}):
+        ov = overrides.lookup("bahdanau_attention", params, "float32")
+        assert (ov.config, ov.source) == ({"bblk": 2}, "forced")
+    ov = overrides.lookup("bahdanau_attention", params, "float32")
+    assert (ov.config, ov.source) == ({"bblk": 4}, "env")
+    monkeypatch.delenv("PT_ATTN_BBLK")
+    ov = overrides.lookup("bahdanau_attention", params, "float32")
+    assert (ov.config, ov.source) == ({"bblk": 16}, "table")
+    # drop the exact entry -> nearest neighbor (B=32, one octave away)
+    t.entries.pop(tcache.entry_key(
+        "bahdanau_attention", tcache.make_sig(params), "float32",
+        tcache.device_kind()))
+    t._lru.clear()
+    t._fp = None
+    ov = overrides.lookup("bahdanau_attention", params, "float32")
+    assert (ov.config, ov.source) == ({"bblk": 8}, "interpolated")
+    assert ov.origin == tcache.make_sig(near)
+    # interpolation off -> analytic (None)
+    FLAGS.tune_interpolate = False
+    try:
+        assert overrides.lookup("bahdanau_attention", params,
+                                "float32") is None
+    finally:
+        FLAGS.tune_interpolate = True
+    # empty pool -> analytic
+    t.entries.clear()
+    t._lru.clear()
+    t._fp = None
+    assert overrides.lookup("bahdanau_attention", params, "float32") is None
+
+
+INTERP_TARGETS = [
+    # neighbors whose configs are NOT legal at the target must be
+    # rejected by the re-check, never returned
+    ({"B": 16, "Sp": 16, "A": 128, "C": 128}, "float32"),
+    ({"B": 24, "Sp": 32, "A": 128, "C": 128}, "float32"),
+    ({"B": 8, "Sp": 16, "A": 128, "C": 128}, "bfloat16"),
+    ({"B": 48, "Sp": 48, "A": 256, "C": 128}, "bfloat16"),
+    ({"B": 128, "Sp": 64, "A": 512, "C": 512}, "bfloat16"),
+]
+
+
+def test_interpolated_config_always_legal_property(tmp_table):
+    """Property (ISSUE-10 acceptance): whatever is in the neighbor
+    pool, an interpolated consult either returns a config that passes
+    space.config_legal for the TARGET shape, or returns nothing. The
+    pool deliberately mixes legal tiles, tiles only legal at their own
+    shape (bblk=32/64), and garbage."""
+    t = overrides.table()
+    pool = [
+        ({"B": 32, "Sp": 16, "A": 128, "C": 128}, "float32", {"bblk": 32}),
+        ({"B": 64, "Sp": 16, "A": 128, "C": 128}, "float32", {"bblk": 64}),
+        ({"B": 32, "Sp": 32, "A": 128, "C": 128}, "float32", {"bblk": 8}),
+        ({"B": 16, "Sp": 32, "A": 128, "C": 128}, "bfloat16", {"bblk": 8}),
+        ({"B": 64, "Sp": 64, "A": 256, "C": 128}, "bfloat16", {"bblk": 8}),
+        ({"B": 96, "Sp": 64, "A": 512, "C": 512}, "bfloat16", {"bblk": 8}),
+        ({"B": 32, "Sp": 16, "A": 128, "C": 128}, "float32",
+         {"bogus": "x"}),
+    ]
+    for p, dt, cfg in pool:
+        t.put("bahdanau_attention", p, dt, cfg)
+    from paddle_tpu.ops.bahdanau_kernels import _bblk
+
+    for params, dtype in INTERP_TARGETS:
+        ov = overrides.lookup("bahdanau_attention", params, dtype)
+        if ov is not None and ov.source == "interpolated":
+            assert space.config_legal("bahdanau_attention", params,
+                                      dtype, ov.config), (params, ov)
+        # and the runtime consult can never produce an illegal tile:
+        item = 2 if dtype == "bfloat16" else 4
+        b = _bblk(params["B"], params["Sp"], params["A"], params["C"],
+                  item)
+        if b:
+            assert space.bahdanau_blk_legal(
+                b, params["B"], params["Sp"], params["A"], params["C"],
+                item)
+
+
+def test_interpolation_rejects_illegal_neighbor_falls_to_analytic(
+        tmp_table):
+    """The NEAREST neighbor's config is illegal at the target (bblk=32
+    does not divide B=24): the re-check must skip it and take the next
+    legal neighbor; with no other neighbor, analytic (None)."""
+    t = overrides.table()
+    target = {"B": 24, "Sp": 16, "A": 128, "C": 128}
+    t.put("bahdanau_attention", {"B": 32, "Sp": 16, "A": 128, "C": 128},
+          "float32", {"bblk": 32})  # nearest, illegal at B=24
+    assert overrides.lookup("bahdanau_attention", target,
+                            "float32") is None
+    t.put("bahdanau_attention", {"B": 48, "Sp": 16, "A": 128, "C": 128},
+          "float32", {"bblk": 8})  # farther, legal at B=24
+    overrides.reload_table()  # drop the memoized miss
+    t = overrides.table()
+    t.put("bahdanau_attention", {"B": 32, "Sp": 16, "A": 128, "C": 128},
+          "float32", {"bblk": 32})
+    t.put("bahdanau_attention", {"B": 48, "Sp": 16, "A": 128, "C": 128},
+          "float32", {"bblk": 8})
+    ov = overrides.lookup("bahdanau_attention", target, "float32")
+    assert ov is not None and ov.source == "interpolated"
+    assert ov.config == {"bblk": 8}
+
+
+def test_interpolation_respects_distance_cap(tmp_table):
+    """A donor beyond INTERP_MAX_DIST (B=128 vs B=8 is ~2.8 octaves =
+    ln(16) > 1.5) must not transfer — far shapes have different tile
+    economics and the analytic default is the better guess."""
+    t = overrides.table()
+    t.put("bahdanau_attention", {"B": 128, "Sp": 16, "A": 128, "C": 128},
+          "float32", {"bblk": 8})
+    assert overrides.lookup(
+        "bahdanau_attention", {"B": 8, "Sp": 16, "A": 128, "C": 128},
+        "float32") is None
+
+
+def test_runtime_consult_uses_interpolated_tile(tmp_table):
+    """End to end through the kernel's own consult point: _bblk at an
+    untuned shape picks up the neighbor's tile when legal (and the
+    golden-numerics test already proves any legal tile is
+    bit-identical)."""
+    from paddle_tpu.ops.bahdanau_kernels import _bblk
+
+    t = overrides.table()
+    t.put("bahdanau_attention", {"B": 32, "Sp": 16, "A": 128, "C": 128},
+          "float32", {"bblk": 16})
+    # B=16: tile 16 is legal (spans nothing illegal) -> interpolated win
+    assert _bblk(16, 16, 128, 128, 4) == 16
+    st = overrides.consult_stats()
+    assert st["interpolated"] >= 1
+
+
+# ------------------------------------------------- fleet database --------
+def test_merge_precedence_measured_beats_interpolated_then_newer():
+    measured_old = {"config": {"bblk": 8},
+                    "meta": {"provenance": "measured", "updated_at": 100}}
+    measured_new = {"config": {"bblk": 16},
+                    "meta": {"provenance": "measured", "updated_at": 200}}
+    interp_newer = {"config": {"bblk": 4},
+                    "meta": {"provenance": "interpolated",
+                             "updated_at": 999}}
+    legacy = {"config": {"bblk": 2}, "meta": {}}
+    # measured beats interpolated regardless of age
+    assert tcache.merge_entry(measured_old, interp_newer) is measured_old
+    assert tcache.merge_entry(interp_newer, measured_old) is measured_old
+    # same provenance: newest wins; ties keep the incumbent
+    assert tcache.merge_entry(measured_old, measured_new) is measured_new
+    assert tcache.merge_entry(measured_new, measured_old) is measured_new
+    assert tcache.merge_entry(measured_old, measured_old) is measured_old
+    # anything beats a legacy no-provenance entry
+    assert tcache.merge_entry(legacy, interp_newer) is interp_newer
+    assert tcache.merge_entry(interp_newer, legacy) is interp_newer
+    # absent incumbent: theirs
+    assert tcache.merge_entry(None, legacy) is legacy
+
+
+def test_table_merge_from_stats(tmp_path):
+    a = tcache.TunedTable(str(tmp_path / "a.json"), autoload=False)
+    b = tcache.TunedTable(str(tmp_path / "b.json"), autoload=False)
+    p1, p2, p3 = ({"B": 8, "H": 128}, {"B": 16, "H": 128},
+                  {"B": 32, "H": 128})
+    a.put("fused_gru", p1, "bfloat16", {"fused": True},
+          device="d", meta={"provenance": "measured", "updated_at": 10})
+    a.put("fused_gru", p2, "bfloat16", {"fused": True},
+          device="d", meta={"provenance": "interpolated",
+                            "updated_at": 10})
+    b.put("fused_gru", p1, "bfloat16", {"fused": False},
+          device="d", meta={"provenance": "interpolated",
+                            "updated_at": 99})   # loses: interp vs measured
+    b.put("fused_gru", p2, "bfloat16", {"fused": False},
+          device="d", meta={"provenance": "measured", "updated_at": 5})
+    b.put("fused_gru", p3, "bfloat16", {"fused": True},
+          device="d", meta={"provenance": "measured", "updated_at": 5})
+    st = a.merge_from(b)
+    assert st == {"added": 1, "replaced": 1, "kept": 1}
+    assert a.get("fused_gru", p1, "bfloat16", device="d") == {"fused": True}
+    assert a.get("fused_gru", p2, "bfloat16", device="d") == {
+        "fused": False}
+    assert a.get("fused_gru", p3, "bfloat16", device="d") == {"fused": True}
+
+
+def test_export_import_round_trip_bit_identical(tmp_path):
+    """export -> import into empty -> export again: BYTE-identical
+    files (the fleet exchange contract: moving a table through a
+    colleague's machine must not mutate it)."""
+    src = tcache.TunedTable(str(tmp_path / "src.json"), autoload=False)
+    src.put("bahdanau_attention", {"B": 256, "Sp": 64, "A": 512,
+                                   "C": 512},
+            "bfloat16", {"bblk": 8}, device="tpu-v5-lite",
+            meta={"provenance": "measured", "updated_at": 123,
+                  "median_s": 3.2e-4})
+    src.put("flash_attention", {"Tq": 2048, "Tk": 2048}, "bfloat16",
+            {"block_q": 512, "block_k": 512}, device="tpu-v5-lite",
+            meta={"provenance": "measured", "updated_at": 124})
+    exp1 = str(tmp_path / "exp1.json")
+    src.save(exp1)
+    mid = tcache.TunedTable(str(tmp_path / "mid.json"), autoload=False)
+    mid.merge_from(tcache.load_strict(exp1))
+    exp2 = str(tmp_path / "exp2.json")
+    mid.save(exp2)
+    with open(exp1, "rb") as f1, open(exp2, "rb") as f2:
+        assert f1.read() == f2.read()
+    assert mid.fingerprint() == src.fingerprint()
+
+
+def test_import_schema_version_gated(tmp_path):
+    bad = tmp_path / "bad.json"
+    bad.write_text(json.dumps({"version": 999, "entries": {}}))
+    with pytest.raises(tcache.TableFormatError, match="schema version"):
+        tcache.load_strict(str(bad))
+    trunc = tmp_path / "trunc.json"
+    trunc.write_text('{"version": 1, "entries": {oops')
+    with pytest.raises(tcache.TableFormatError, match="not JSON"):
+        tcache.load_strict(str(trunc))
+    malformed = tmp_path / "mal.json"
+    malformed.write_text(json.dumps(
+        {"version": 1, "entries": {"k": {"config": 7}}}))
+    with pytest.raises(tcache.TableFormatError, match="malformed"):
+        tcache.load_strict(str(malformed))
+
+
+def test_base_table_read_through(tmp_table, tmp_path, monkeypatch):
+    """A shipped per-device base table is consulted beneath the local
+    table: base-only keys hit (source "table"), a local entry shadows
+    the base one, and the base feeds the interpolation pool. The
+    overrides fingerprint must react to the base layer (jit-cache-key
+    contract)."""
+    base_dir = tmp_path / "tables"
+    base_dir.mkdir()
+    base = tcache.TunedTable(
+        str(base_dir / f"{tcache.device_kind()}.json"), autoload=False)
+    pA = {"B": 64, "Sp": 16, "A": 128, "C": 128}
+    pB = {"B": 32, "Sp": 16, "A": 128, "C": 128}
+    base.put("bahdanau_attention", pA, "float32", {"bblk": 64},
+             provenance="measured")
+    base.put("bahdanau_attention", pB, "float32", {"bblk": 32},
+             provenance="measured")
+    base.save()
+    fp_nobase = overrides.fingerprint()
+    monkeypatch.setenv("PT_TUNE_TABLES_DIR", str(base_dir))
+    overrides.reload_table()
+    assert overrides.fingerprint() != fp_nobase
+    # base-only key: read-through hit
+    ov = overrides.lookup("bahdanau_attention", pA, "float32")
+    assert (ov.config, ov.source) == ({"bblk": 64}, "table")
+    # local entry shadows the base layer
+    overrides.table().put("bahdanau_attention", pA, "float32",
+                          {"bblk": 8})
+    ov = overrides.lookup("bahdanau_attention", pA, "float32")
+    assert ov.config == {"bblk": 8}
+    # base entries seed interpolation for nearby shapes (B=16 target:
+    # nearest donor is pB at one octave; its bblk=32 is illegal at
+    # B=16 -> next duty falls to the legal local bblk=8 at pA)
+    ov = overrides.lookup(
+        "bahdanau_attention", {"B": 16, "Sp": 16, "A": 128, "C": 128},
+        "float32")
+    assert ov is not None and ov.source == "interpolated"
+    assert space.config_legal(
+        "bahdanau_attention", {"B": 16, "Sp": 16, "A": 128, "C": 128},
+        "float32", ov.config)
+
+
+def test_shipped_v5lite_base_table_is_valid():
+    """The table the package actually ships: loads strict (current
+    schema), every entry is keyed for tpu-v5-lite with measured
+    provenance, and every config passes its OWN shape's legality —
+    shipping can never hand any device an illegal tile, and on CPU
+    (device_kind 'cpu') it is never even consulted."""
+    path = os.path.join(os.path.dirname(space.__file__), "tables",
+                        "tpu-v5-lite.json")
+    t = tcache.load_strict(path)
+    assert len(t) >= 20
+    for key, e in t.entries.items():
+        kernel, sig, dtype, device = tcache.parse_key(key)
+        assert device == "tpu-v5-lite"
+        assert e["meta"]["provenance"] == "measured"
+        params = tcache.sig_to_params(sig)
+        assert space.config_legal(kernel, params, dtype, e["config"]), key
+    # and the default CPU base-table resolution ignores it
+    assert tcache.base_table_path() is None
+
+
+# ------------------------------------------------ provenance counters ----
+def test_consult_counters_and_metrics_export(tmp_table):
+    pt.reset()  # zero the counters
+    overrides.set_table_path(tmp_table)
+    t = overrides.table()
+    params = {"B": 16, "Sp": 16, "A": 128, "C": 128}
+    assert overrides.lookup("bahdanau_attention", params,
+                            "float32") is None  # analytic
+    t.put("bahdanau_attention", params, "float32", {"bblk": 8})
+    overrides.lookup("bahdanau_attention", params, "float32")  # table
+    t.put("bahdanau_attention", {"B": 32, "Sp": 16, "A": 128, "C": 128},
+          "float32", {"bblk": 8})
+    overrides.lookup("bahdanau_attention",
+                     {"B": 64, "Sp": 16, "A": 128, "C": 128},
+                     "float32")  # interpolated (B=32 donor, legal)
+    with overrides.forcing("bahdanau_attention", {"bblk": 8}):
+        overrides.lookup("bahdanau_attention", params, "float32")
+    st = overrides.consult_stats()
+    assert st["analytic"] >= 1 and st["table"] >= 1
+    assert st["interpolated"] >= 1 and st["forced"] >= 1
+    # the unified registry renders every source label, 0s included
+    from paddle_tpu.obs import metrics as obs_metrics
+    from paddle_tpu.obs import promparse
+
+    text = obs_metrics.registry().render()
+    fams = promparse.parse_text(text)
+    series = {lb["source"]: v for _, lb, v in
+              fams["pt_tune_consults_total"].samples}
+    assert set(series) == {"forced", "env", "table", "interpolated",
+                           "analytic"}
+    assert series["env"] == 0
+    assert series["interpolated"] >= 1
+    # classify() must NOT move the counters (warmup coverage contract)
+    before = overrides.consult_stats()
+    overrides.classify("bahdanau_attention", params, "float32")
+    assert overrides.consult_stats() == before
+
+
+def test_engine_decode_tune_cases_mesh_local(tmp_path, tmp_table):
+    """ISSUE-10 tentpole (d), serving side: a mesh replica's decode
+    tune cases key on the PER-SHARD batch (bucket/dp), and buckets the
+    dp axis does not divide are skipped — mirroring what the fused
+    kernels actually dispatch inside shard_map."""
+    from paddle_tpu.parallel import mesh_from_spec
+    from paddle_tpu.serving import BucketPolicy, ServingEngine
+
+    enc = pt.layers.data("enc", shape=[8, 8, 128],
+                         append_batch_size=False, lod_level=1)
+    trg = pt.layers.data("trg", shape=[8, 6], append_batch_size=False,
+                         lod_level=1)
+    boot = pt.layers.data("boot", shape=[8, 128],
+                          append_batch_size=False)
+    dec = pt.layers.attention_gru_decoder(enc, trg, boot, size=128,
+                                          src_max_len=8, trg_max_len=8)
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    d = str(tmp_path / "dec_model")
+    pt.io.save_inference_model(d, ["enc", "trg", "boot"], [dec])
+
+    pol = BucketPolicy(max_batch_size=4, batch_buckets=(2, 4))
+    single = ServingEngine(d, policy=pol)
+    b_single = sorted(c["params"]["B"] for c in single.decode_tune_cases()
+                      if c["family"] == "bahdanau_attention")
+    assert b_single == [2, 4]  # the bucket grid itself, K=1
+    meshed = ServingEngine(d, policy=pol, mesh=mesh_from_spec("dp2"))
+    b_mesh = sorted(c["params"]["B"] for c in meshed.decode_tune_cases()
+                    if c["family"] == "bahdanau_attention")
+    assert b_mesh == [1, 2]  # per-shard: bucket/dp
+    # coverage classification keys on the same per-shard shapes
+    # (Sp = pad_s(8) = 16; B=4 is the program's own concrete-batch site
+    # 8/dp — also per-shard via cases_from_program(dp=2))
+    sigs = {c["sig"] for c in meshed.tune_coverage()
+            if c["family"] == "bahdanau_attention"}
+    assert sigs == {"A=128,B=1,C=128,Sp=16", "A=128,B=2,C=128,Sp=16",
+                    "A=128,B=4,C=128,Sp=16"}
+
+
+# ------------------------------------------- warmup coverage report ------
+def test_serving_warmup_names_untuned_and_interpolated(tmp_path,
+                                                       tmp_table):
+    """The upgraded stale-table warning: names WHICH kernels/shapes are
+    untuned vs interpolated and gives the actionable tune command."""
+    from paddle_tpu.serving import ServingEngine
+
+    q = pt.layers.data("q", shape=[1024, 256])
+    out = pt.layers.multi_head_attention(q, num_heads=2, causal=False)
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    model_dir = str(tmp_path / "model")
+    pt.io.save_inference_model(model_dir, ["q"], [out])
+    engine = ServingEngine(model_dir)
+    # make provenance stale so the warning fires
+    overrides.table().put("fused_conv", {"n": 512, "cin": 128,
+                                         "cout": 128}, "bfloat16",
+                          {"block_rows": 128})
+    with pytest.warns(UserWarning) as rec:
+        assert not engine.check_tuned_table()
+    msg = "\n".join(str(w.message) for w in rec)
+    assert "untuned (analytic defaults)" in msg
+    assert "flash_attention[Tk=1024,Tq=1024" in msg
+    assert "paddle_tpu tune" in msg
+    # tune the shape's neighbor -> same site reports interpolated
+    overrides.table().put("flash_attention", {"Tq": 2048, "Tk": 2048},
+                          "float32", {"block_q": 512, "block_k": 512})
+    cov = engine.tune_coverage()
+    flash = [c for c in cov if c["family"] == "flash_attention"]
+    assert flash and flash[0]["source"] == "interpolated"
+    assert flash[0]["origin"] == "Tk=2048,Tq=2048"
+    with pytest.warns(UserWarning) as rec:
+        engine.check_tuned_table()
+    msg = "\n".join(str(w.message) for w in rec)
+    assert "interpolated from nearby shapes" in msg
+    # exact-tune the shape -> coverage goes clean, warning loses it
+    overrides.table().put("flash_attention", {"Tq": 1024, "Tk": 1024},
+                          "float32", {"block_q": 512, "block_k": 512})
+    cov = engine.tune_coverage()
+    flash = [c for c in cov if c["family"] == "flash_attention"]
+    assert flash and flash[0]["source"] == "table"
