@@ -34,6 +34,8 @@ from . import io  # noqa: F401
 from . import layers  # noqa: F401
 from . import networks  # noqa: F401
 from . import optimizer  # noqa: F401
+from . import pipeline  # noqa: F401
+from .pipeline import PipelineExecutor  # noqa: F401
 from . import regularizer  # noqa: F401
 from . import resilience  # noqa: F401
 from . import serving  # noqa: F401

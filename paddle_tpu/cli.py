@@ -17,7 +17,17 @@ Python file that builds the model on the default programs and exposes
         }
 
 Commands:
-  train       --config M.py [--num_passes N] [--save_dir D] [flags...]
+  train       --config M.py [--num_passes N] [--save_dir D]
+              [--mesh dp2,pp2] [--microbatches M] [--pipeline_stages K]
+              [flags...]
+              --mesh trains over a device mesh (axes dp/mp/sp/pp). A
+              pp axis — or --pipeline_stages K — selects the
+              micro-batch pipeline executor (paddle_tpu/pipeline):
+              the program is cut into K stages (stage_boundary()
+              markers or auto-balanced), each step drives
+              --microbatches M slices through the GPipe tick grid
+              (default M = 2K; bubble fraction (K-1)/(M+K-1)). A
+              dp/mp-only mesh selects the ParallelExecutor.
               notable flags for the pipelined loop (README "Training"):
               --prefetch_to_device N  DevicePrefetcher queue depth
                                       (default 2; 0 disables)
@@ -131,7 +141,7 @@ def _cmd_train(argv) -> int:
 
     from .trainer import CheckpointConfig, Trainer
 
-    train_opts = ("config", "num_passes", "save_dir", "trace_out")
+    train_opts = ("config", "num_passes", "save_dir", "trace_out", "mesh")
     cfg = {}
     rest = []
     i = 0
@@ -203,7 +213,38 @@ def _cmd_train(argv) -> int:
     # past the last pass and train nothing
     save_dir = cfg.get("save_dir", "")
     ckpt = CheckpointConfig(checkpoint_dir=save_dir) if save_dir else None
-    trainer = Trainer(cost=model["cost"], checkpoint_config=ckpt)
+    executor = None
+    mesh_spec = cfg.get("mesh", "")
+    if mesh_spec or FLAGS.pipeline_stages or FLAGS.microbatches:
+        # --mesh dp2,pp2 trains over a device mesh; a pp axis (or
+        # --pipeline_stages) selects the micro-batch pipeline executor,
+        # a dp/mp-only mesh the ParallelExecutor
+        mesh = None
+        pp_size = 1
+        if mesh_spec:
+            from .parallel.mesh import mesh_from_spec, parse_mesh_spec
+
+            try:
+                pp_size = dict(parse_mesh_spec(mesh_spec)).get("pp", 1)
+                mesh = mesh_from_spec(mesh_spec)
+            except ValueError as e:
+                raise SystemExit(f"--mesh {mesh_spec}: {e}") from None
+        stages = int(FLAGS.pipeline_stages) or pp_size
+        if stages > 1 or FLAGS.microbatches:
+            from .pipeline import PipelineExecutor
+
+            stages = max(stages, 1)
+            executor = PipelineExecutor(
+                num_stages=stages,
+                num_microbatches=int(FLAGS.microbatches) or 2 * stages,
+                mesh=mesh,
+            )
+        elif mesh is not None:
+            from .parallel import ParallelExecutor
+
+            executor = ParallelExecutor(mesh)
+    trainer = Trainer(cost=model["cost"], checkpoint_config=ckpt,
+                      executor=executor)
 
     def log_handler(event):
         from .trainer import EndIteration, EndPass

@@ -140,6 +140,20 @@ define_flag("scan_window", 0,
             "yet). Checkpoint cadence and StepGuard detection quantize "
             "to window boundaries (PERF.md 'Breaking the dispatch "
             "floor')")
+define_flag("microbatches", 0,
+            "pipeline executor: micro-batches M per global batch (CLI "
+            "--microbatches, env: PT_FLAGS_MICROBATCHES). Each step "
+            "splits the batch into M slices driven through the K-stage "
+            "GPipe tick grid (paddle_tpu/pipeline); bubble fraction is "
+            "(K-1)/(M+K-1), so more micro-batches amortize the "
+            "fill/drain ticks. 0 = default 2x the stage count")
+define_flag("pipeline_stages", 0,
+            "pipeline executor: stage count K for `train --mesh` runs "
+            "(CLI --pipeline_stages, env: PT_FLAGS_PIPELINE_STAGES). "
+            "0 = follow the mesh's pp axis size (meshless: no "
+            "pipelining). Must be a multiple of the pp axis; the "
+            "program is cut at stage_boundary() markers when their "
+            "count matches K-1, else auto-balanced by op cost")
 define_flag("prefetch_to_device", 2,
             "trainer: default DevicePrefetcher queue depth — batch N+1's "
             "host->device transfer overlaps batch N's compute "

@@ -508,6 +508,30 @@ def verify_checkpoint(dirname: str) -> None:
             f"{dirname}: unreadable meta ({e})") from e
     integrity = meta.get("integrity")
     if not isinstance(integrity, dict):
+        # pre-hardening save. For the sharded format we can still check
+        # STRUCTURE: every shard file the manifest references must exist
+        # (a retention sweep or partial copy that dropped one shard file
+        # would otherwise pass verify and fail mid-restore)
+        smeta_path = os.path.join(dirname, SHARDED_META)
+        if os.path.exists(smeta_path):
+            try:
+                with open(smeta_path) as f:
+                    smeta = json.load(f)
+            except (OSError, ValueError) as e:
+                raise CheckpointCorruptError(
+                    f"{dirname}: unreadable sharded meta ({e})") from e
+            procs = {0} | {
+                e["process"]
+                for info in smeta.get("vars", {}).values()
+                if info.get("kind") == "sharded"
+                for e in info.get("shards", [])
+            }
+            for p in sorted(procs):
+                if not os.path.exists(
+                        os.path.join(dirname, f"shards_p{p}.npz")):
+                    raise CheckpointCorruptError(
+                        f"{dirname}: shard file shards_p{p}.npz referenced "
+                        "by the manifest is missing")
         return
     for fname, want in sorted(integrity.items()):
         path = os.path.join(dirname, fname)
@@ -722,7 +746,18 @@ def save_sharded_checkpoint(
     pid = jax.process_index()
     names = sorted(v.name for v in program.persistables() if scope.has(v.name))
 
-    meta: Dict[str, Any] = {"vars": {}, "num_processes": jax.process_count()}
+    # the saving world travels with the manifest: a restore on a
+    # different chip/process count is legitimate (elastic resume — the
+    # loader assembles GLOBAL arrays either way) but must be observable
+    # (pipeline.elastic counts it as pt_ckpt_reshard_total)
+    meta: Dict[str, Any] = {
+        "vars": {},
+        "num_processes": jax.process_count(),
+        "world": {
+            "device_count": int(jax.device_count()),
+            "process_count": int(jax.process_count()),
+        },
+    }
     local: Dict[str, np.ndarray] = {}
     for n in names:
         val = scope.get(n)
@@ -838,32 +873,43 @@ def load_sharded_checkpoint(
         keep = {v.name for v in main_program.persistables()}
         meta["vars"] = {n: i for n, i in meta["vars"].items() if n in keep}
     # open only files the manifest references (a reused directory may
-    # hold stale shards_pK.npz from an older, larger job)
+    # hold stale shards_pK.npz from an older, larger job). A single torn
+    # shard file raises a TYPED CheckpointCorruptError naming it, so
+    # load_checkpoint's newest-VALID-serial loop quarantines this serial
+    # and falls back — one damaged shard costs one checkpoint interval,
+    # never the restore.
     procs = {0} | {
         e["process"]
         for info in meta["vars"].values() if info["kind"] == "sharded"
         for e in info["shards"]
     }
-    files = {
-        p: np.load(os.path.join(dirname, f"shards_p{p}.npz")) for p in procs
-    }
-    # stage everything on host BEFORE committing to the scope: a corrupt
-    # shard file surfaces during assembly and leaves the scope untouched
-    # (load_checkpoint then falls back to the previous serial)
-    staging: Dict[str, np.ndarray] = {}
+    files = {}
     try:
+        for p in sorted(procs):
+            fname = f"shards_p{p}.npz"
+            try:
+                files[p] = np.load(os.path.join(dirname, fname))
+            except _SHARD_READ_ERRORS as e:
+                raise CheckpointCorruptError(
+                    f"{dirname}: shard file {fname} is unreadable "
+                    f"({type(e).__name__}: {e})") from e
+        # stage everything on host BEFORE committing to the scope: a
+        # corrupt shard surfaces during assembly and leaves the scope
+        # untouched (load_checkpoint then falls back)
+        staging: Dict[str, np.ndarray] = {}
         for var, info in meta["vars"].items():
             if info["kind"] == "replicated":
-                staging[var] = files[0][f"{var}::r"]
+                staging[var] = _read_shard(files, 0, f"{var}::r", dirname)
             else:
                 out = np.zeros(info["shape"], np.dtype(info["dtype"]))
                 covered = np.zeros(info["shape"], bool)
                 for e in info["shards"]:
                     sl = tuple(slice(a, b) for a, b in e["slice"])
-                    out[sl] = files[e["process"]][e["key"]]
+                    out[sl] = _read_shard(
+                        files, e["process"], e["key"], dirname)
                     covered[sl] = True
                 if not covered.all():
-                    raise ValueError(
+                    raise CheckpointCorruptError(
                         f"sharded checkpoint: {var} has uncovered slices "
                         f"({int((~covered).sum())} of {covered.size} "
                         "elements) — incomplete save?"
@@ -876,4 +922,37 @@ def load_sharded_checkpoint(
     for var, val in staging.items():
         scope.set(var, val)
         loaded.append(var)
+    # elastic resume: restoring into a different world than the one that
+    # saved is the resharding path — count it (pipeline.elastic declares
+    # the family at construction; lazy import avoids an io<->pipeline
+    # import cycle at package-init time)
+    world = meta.get("world")
+    if world:
+        import jax
+
+        cur = {"device_count": int(jax.device_count()),
+               "process_count": int(jax.process_count())}
+        if any(int(world.get(k, v)) != v for k, v in cur.items()):
+            from .pipeline.elastic import count_reshard
+
+            count_reshard()
     return loaded
+
+
+# shard files are read lazily by np.load: a torn zip can surface at
+# open OR at member access, with container-format errors (BadZipFile,
+# short reads) or npy-payload errors (ValueError)
+_SHARD_READ_ERRORS = (OSError, ValueError, EOFError, zipfile.BadZipFile)
+
+
+def _read_shard(files, process: int, key: str, dirname: str) -> np.ndarray:
+    try:
+        return files[process][key]
+    except KeyError as e:
+        raise CheckpointCorruptError(
+            f"{dirname}: shards_p{process}.npz is missing member {key!r} "
+            "(truncated or stale shard file)") from e
+    except _SHARD_READ_ERRORS as e:
+        raise CheckpointCorruptError(
+            f"{dirname}: shard {key!r} in shards_p{process}.npz is "
+            f"unreadable ({type(e).__name__}: {e})") from e

@@ -22,6 +22,11 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 DP, MP, SP, PP = "dp", "mp", "sp", "pp"
 
+# the full textual vocabulary — parse_mesh_spec rejects anything else so
+# a typo ("ddp8") fails at the CLI instead of producing a mesh whose axis
+# no sharding rule ever matches (silently replicated everything)
+KNOWN_AXES = (DP, MP, SP, PP)
+
 
 def make_mesh(
     shape: Optional[Sequence[int]] = None,
@@ -39,8 +44,9 @@ def make_mesh(
 
 
 def parse_mesh_spec(spec: str) -> Tuple[Tuple[str, int], ...]:
-    """"dp4,mp2" -> (("dp", 4), ("mp", 2)) — the textual mesh vocabulary
-    shared by bench.py's BENCH_MESH and `cli serve --mesh`."""
+    """"dp4,pp2" -> (("dp", 4), ("pp", 2)) — the textual mesh vocabulary
+    shared by bench.py's BENCH_MESH, `cli serve --mesh`, and
+    `cli train --mesh`. Axis names are restricted to KNOWN_AXES."""
     import re
 
     axes = []
@@ -48,8 +54,17 @@ def parse_mesh_spec(spec: str) -> Tuple[Tuple[str, int], ...]:
         m = re.fullmatch(r"([a-z]+)(\d+)", part.strip())
         if not m:
             raise ValueError(
-                f"bad mesh axis {part!r}; want e.g. dp4 or mp2")
-        axes.append((m.group(1), int(m.group(2))))
+                f"bad mesh axis {part!r}; want e.g. dp4 or pp2")
+        name, size = m.group(1), int(m.group(2))
+        if name not in KNOWN_AXES:
+            raise ValueError(
+                f"unknown mesh axis {name!r} in {part!r}; "
+                f"known axes: {', '.join(KNOWN_AXES)}")
+        if size < 1:
+            raise ValueError(f"mesh axis {part!r} must have size >= 1")
+        if any(a == name for a, _ in axes):
+            raise ValueError(f"duplicate mesh axis {name!r} in {spec!r}")
+        axes.append((name, size))
     if not axes:
         raise ValueError(f"empty mesh spec {spec!r}")
     return tuple(axes)

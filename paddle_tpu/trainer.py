@@ -413,9 +413,11 @@ class CheckpointConfig:
         self.sharded = sharded
         # background=True hands the disk commit to a writer thread over a
         # device_get snapshot, so the step loop stalls only for the d2h
-        # copy, not the serialization+fsync. Sharded/multi-process saves
-        # stay synchronous: their cross-process barriers must run on the
-        # thread every process is blocking on.
+        # copy, not the serialization+fsync. Single-process sharded saves
+        # background too, via a reference-only snapshot (jax.Array is
+        # immutable) whose d2h happens on the writer thread. Multi-process
+        # sharded saves stay synchronous: their cross-process barriers
+        # must run on the thread every process is blocking on.
         self.background = background
 
 
@@ -506,6 +508,12 @@ class Trainer:
                   read(lambda t: t.step_guard.rollbacks
                        if t.step_guard else 0),
                   help="StepGuard checkpoint rollbacks performed")
+        # elastic-restore accounting is a counter owned by io/pipeline;
+        # re-declaring here keeps it scrapeable at 0 from the moment a
+        # trainer exists, whatever reset_metrics/construction order ran
+        from .pipeline.elastic import declare_reshard_counter
+
+        declare_reshard_counter()
 
     # -- periodic stats line (ISSUE 8: training runs get the same
     # observability surface serving scrapes) ------------------------------
@@ -703,7 +711,12 @@ class Trainer:
             # quietly cost days of confusion)
             logging.getLogger("paddle_tpu.trainer").warning(
                 "scan_window=%d requested but %s does not support fused "
-                "step windows — falling back to the per-step loop",
+                "step windows — falling back to the per-step loop. For "
+                "fused multi-step dispatch at scale, the meshless "
+                "pipeline.PipelineExecutor supports scan windows (a "
+                "window there is a scan over steps of the stage-grid "
+                "scan); see `paddle_tpu train --mesh dp2,pp2 "
+                "--microbatches M`",
                 scan_k, type(self.exe).__name__)
             scan_k = 0
         if scan_k and FLAGS.show_param_stats_period:
@@ -1150,9 +1163,30 @@ class Trainer:
                     "to silence this)"
                 )
             sharded = True
+        if sharded and getattr(cc, "background", True) \
+                and jax.process_count() == 1:
+            # single-process sharded saves have no cross-process barriers,
+            # so the commit rides the writer-thread double buffer. The
+            # snapshot is reference-only (jax.Array is immutable), so
+            # submit latency is the drain of the PREVIOUS commit plus
+            # dict-building — the d2h copy of each unique shard happens
+            # on the writer thread (pipeline/elastic.py)
+            from .pipeline import elastic
+
+            with profiler.timer("checkpointSnapshot"):
+                elastic.submit_sharded_save(
+                    self._ckpt_writer,
+                    cc.checkpoint_dir,
+                    trainer_args=args,
+                    main_program=self.main_program,
+                    scope=self.scope,
+                    max_num_checkpoints=cc.max_num_checkpoints,
+                )
+            return
         if sharded or not getattr(cc, "background", True):
-            # sharded saves barrier across processes — every process must
-            # actually be executing the save, so it stays on this thread
+            # multi-process sharded saves barrier across processes —
+            # every process must actually be executing the save, so they
+            # stay on this thread (as does background=False by request)
             io.save_checkpoint(
                 cc.checkpoint_dir,
                 trainer_args=args,

@@ -48,6 +48,13 @@ import pytest  # noqa: E402
 # long-standing "22 shard_map failures" of CHANGES.md are exactly this.
 HAS_SHARD_MAP = hasattr(jax, "shard_map")
 
+# the pipeline executor's mesh mode places stages on a pp mesh axis —
+# meaningless (and unconstructible: dp*pp > devices) with one device.
+# Probed once at import like HAS_SHARD_MAP; on the simulated 8-device
+# CPU mesh above this is True, on a 1-device CI host the marked tests
+# become environment skips.
+HAS_MULTIDEVICE_PP = len(jax.devices()) >= 2
+
 _MP_PROBE_CHILD = r"""
 import os
 import numpy as np
@@ -119,11 +126,17 @@ def pytest_collection_modifyitems(config, items):
         reason="environment: this jaxlib's CPU backend does not "
                "implement multiprocess computations (probed once by "
                "conftest.cpu_multiprocess_ok)")
+    skip_pp = pytest.mark.skip(
+        reason="environment: a single-device backend cannot place "
+               "pipeline stages on a pp mesh axis")
     for it in items:
         if not HAS_SHARD_MAP and it.get_closest_marker("needs_shard_map"):
             it.add_marker(skip_sm)
         if not mp_ok and it.get_closest_marker("needs_cpu_multiprocess"):
             it.add_marker(skip_mp)
+        if (not HAS_MULTIDEVICE_PP
+                and it.get_closest_marker("needs_multidevice_pp")):
+            it.add_marker(skip_pp)
 
 
 def pytest_configure(config):
@@ -136,6 +149,11 @@ def pytest_configure(config):
         "needs_cpu_multiprocess: requires multiprocess computations on "
         "the CPU backend (2-process jax.distributed collectives); "
         "probed once per session, skipped where unimplemented")
+    config.addinivalue_line(
+        "markers",
+        "needs_multidevice_pp: requires >= 2 devices to place pipeline "
+        "stages on a pp mesh axis; skipped (environment, not failure) "
+        "on single-device backends")
     config.addinivalue_line(
         "markers",
         "slow: long-running; excluded from the tier-1 run (-m 'not slow')")

@@ -353,3 +353,99 @@ def test_sharded_injected_shard_corruption(tmp_path):
     assert faults.stats()["ckpt.write"]["fired"] == 1
     with pytest.warns(UserWarning, match="quarantined"):
         assert pio.load_checkpoint(d, prog)["step"] == 1
+
+
+# ------------------------------------ elastic restart on a new mesh shape
+
+
+@pytest.mark.chaos
+def test_sigterm_sharded_restart_on_different_mesh_bitwise(tmp_path):
+    """ISSUE 14 acceptance: SIGTERM lands mid-pass in a dp-sharded
+    (ZeRO optimizer state) run whose checkpoints commit sharded on the
+    background writer; the restart happens on a DIFFERENT mesh shape
+    (dp8 -> dp4x2) and must end with parameters BIT-IDENTICAL to an
+    uninterrupted reference that checkpoints and switches mesh at the
+    same step — the emergency path and the elastic reshard are both
+    exact, not approximately correct."""
+    import jax
+
+    from paddle_tpu import parallel as pp
+
+    assert len(jax.devices()) == 8
+
+    def build():
+        pt.reset()
+        pt.default_main_program().random_seed = 13
+        pt.default_startup_program().random_seed = 13
+        x = pt.layers.data("x", shape=[8])
+        y = pt.layers.data("y", shape=[1])
+        h = pt.layers.fc(x, size=16, act="relu")
+        pred = pt.layers.fc(h, size=1)
+        loss = pt.layers.mean(pt.layers.square_error_cost(pred, y))
+        pt.optimizer.Adam(learning_rate=0.05).minimize(loss)
+        return loss
+
+    def batches(lo, hi):
+        def reader():
+            for i in range(lo, hi):
+                rng = np.random.RandomState(100 + i)
+                xs = rng.randn(8, 8).astype(np.float32)
+                yield {"x": xs, "y": xs.sum(1, keepdims=True)}
+        return reader
+
+    def exe_on(spec):
+        return pp.ParallelExecutor(pp.mesh_from_spec(spec),
+                                   shard_optimizer_state=True)
+
+    def host_params():
+        return {n: np.asarray(pt.global_scope().get(n))
+                for n in sorted(pt.global_scope().keys())
+                if not n.startswith("@")}
+
+    # --- interrupted arm: dp8, SIGTERM after batch 2, emergency
+    # sharded checkpoint on the background writer ----------------------
+    d = str(tmp_path / "ck")
+    loss = build()
+    cc = pt.CheckpointConfig(d, epoch_interval=0, sharded=True)
+    assert cc.background
+    t = pt.Trainer(loss, checkpoint_config=cc, executor=exe_on("dp8"))
+
+    def preempt_at_3(e):
+        if isinstance(e, pt.EndIteration) and e.step == 3:
+            os.kill(os.getpid(), signal.SIGTERM)
+
+    with pytest.raises(pt.resilience.PreemptedError, match="SIGTERM"):
+        t.train(batches(0, 6), num_passes=1, event_handler=preempt_at_3,
+                log_interval=1)
+    assert t._ckpt_writer._idle.is_set()  # emergency commit fully drained
+    args = json.load(open(os.path.join(
+        d, f"checkpoint_{pio.get_latest_checkpoint_serial(d)}",
+        pio.META_FILE)))["trainer_args"]
+    assert args["step"] == 3 and args["mid_pass"]
+
+    # restart on dp4x2: resumes pass 0 at batch 3, finishes the pass
+    loss = build()
+    t2 = pt.Trainer(loss, checkpoint_config=pt.CheckpointConfig(
+        d, epoch_interval=0, sharded=True), executor=exe_on("dp4,mp2"))
+    t2.train(batches(0, 6), num_passes=1, log_interval=1)
+    assert t2.step == 6
+    interrupted = host_params()
+
+    # --- reference arm: same schedule, no SIGTERM — 3 batches on dp8,
+    # checkpoint, then batches 3..5 on dp4x2 ---------------------------
+    d_ref = str(tmp_path / "ck_ref")
+    loss = build()
+    tr1 = pt.Trainer(loss, executor=exe_on("dp8"))
+    tr1.train(batches(0, 3), num_passes=1, log_interval=1)
+    pio.save_checkpoint(d_ref, {"step": 3}, pt.default_main_program(),
+                        sharded=True)
+    loss = build()
+    tr2 = pt.Trainer(loss, checkpoint_config=pt.CheckpointConfig(
+        d_ref, epoch_interval=0, sharded=True), executor=exe_on("dp4,mp2"))
+    tr2.train(batches(3, 6), num_passes=1, log_interval=1)
+    ref = host_params()
+
+    assert set(interrupted) == set(ref)
+    bad = [n for n in ref
+           if not np.array_equal(ref[n], interrupted[n])]
+    assert not bad, f"elastic restart diverged from reference: {bad[:6]}"
