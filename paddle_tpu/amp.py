@@ -30,10 +30,70 @@ import jax.numpy as jnp
 
 AMP_KEY = "@AMP@"
 
+# --------------------------------------------------------------------------
+# The dtype-policy table: which op families may drop precision.
+#
+# ONE place for the "may this site compute below f32?" judgment, consulted
+# by BOTH precision passes — amp (bf16 compute, cast_inputs below) and the
+# post-training int8 converter (quant/convert.py). Before this table the
+# policy lived implicitly in which kernels called cast_inputs, and the
+# quant pass would have had to re-derive (and could silently drift from)
+# the batch_norm/softmax exclusions. Now a site is:
+#
+#   "low"    — MXU-bound, numerically tolerant: amp casts its inputs down,
+#              and the quant converter may rewrite it to an int8 kernel
+#              when it carries a persistable weight (LOW_PRECISION_OPS ∩
+#              QUANTIZABLE_OPS);
+#   "high"   — numerically sensitive (stats, exps/logs, losses): the
+#              kernel upcasts internally, cast_inputs is a no-op even if
+#              called, and the quant converter must leave it alone;
+#   "follow" — dtype-transparent (elementwise glue, reshapes): follows
+#              whatever dtype its inputs already carry via harmonize.
+# --------------------------------------------------------------------------
+
+# MXU ops whose kernels call cast_inputs: inputs drop to the amp dtype.
+LOW_PRECISION_OPS = frozenset({
+    "mul", "matmul", "conv2d", "conv2d_transpose", "fused_conv_bn",
+    "flash_attention", "lookup_table",
+})
+
+# The subset of low-precision sites the int8 converter may rewrite: dense
+# weight-carrying GEMMs with a quantized lowering (ops/quant_kernels.py).
+# conv2d lowers through im2col+mul in this runtime, so the mul sites are
+# the conv sites too; fused_conv_bn folds BN stats and must stay fp.
+QUANTIZABLE_OPS = frozenset({"mul", "matmul"})
+
+# Numerically sensitive: upcast internally, emit f32, never quantized.
+# batch_norm/softmax live HERE and only here — amp and quant both read
+# this set, so the exclusions cannot drift between the two passes.
+HIGH_PRECISION_OPS = frozenset({
+    "batch_norm", "layer_norm", "softmax", "log_softmax",
+    "cross_entropy", "softmax_with_cross_entropy", "mean",
+    "reduce_mean", "huber_loss", "smooth_l1", "squared_l2_norm",
+    "l2_normalize", "exp", "log",
+})
+
+
+def precision_policy(op_type: str) -> str:
+    """'low' | 'high' | 'follow' for one op type (see table above)."""
+    if op_type in HIGH_PRECISION_OPS:
+        return "high"
+    if op_type in LOW_PRECISION_OPS:
+        return "low"
+    return "follow"
+
 
 def cast_inputs(ctx, *arrays):
-    """Cast float32 arrays to the program's amp dtype (no-op otherwise)."""
+    """Cast float32 arrays to the program's amp dtype (no-op otherwise).
+
+    Consults precision_policy: a kernel on the HIGH_PRECISION list gets
+    its inputs back untouched even if it (mistakenly) calls this — the
+    exclusion table, not the call site, decides who drops precision."""
     dtype = ctx.env.get(AMP_KEY)
+    op = getattr(ctx, "op", None)
+    if dtype is not None and op is not None \
+            and precision_policy(op.type) == "high":
+        dtype = None
     out = []
     for a in arrays:
         if (

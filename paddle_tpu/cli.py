@@ -57,7 +57,7 @@ Commands:
               [--max_batch_size N] [--max_wait_ms M] [--max_queue Q]
               [--timeout_ms T] [--seq_len_buckets 64,128,...] [--warmup 0|1]
               [--max_slots S] [--gen_queue Q] [--gen_timeout_ms T]
-              [--mesh dp1,mp2] [--drain_s S]
+              [--mesh dp1,mp2] [--drain_s S] [--quant int8]
               [--replicas N [--standby K] [--probe_interval_ms P]]
               batching HTTP inference server over saved inference
               models (paddle_tpu.serving): /predict, /healthz, /metrics
@@ -74,12 +74,29 @@ Commands:
               and /generate over them (streaming passes through),
               retries shed/503s on another replica, circuit-breaks and
               replaces dead replicas (paddle_tpu.serving.router)
-  route       --replica http://host:port [--replica ...] [--host H]
+              --quant int8 asserts the artifact is a quantized one
+              (see `quant` below) and serves its low-precision fast
+              path; an fp artifact fails loudly instead of silently
+              serving at fp cost
+  quant       --model_dir D --out O [--samples N] [--mode int8]
+              [--no-check]
+              post-training int8 quantization of a saved inference
+              artifact (paddle_tpu.quant): calibrates activation
+              ranges on N deterministic synthetic samples drawn from
+              the artifact's feed specs (default 8), rewrites matmul
+              sites to int8 kernels with per-channel weight scales,
+              prints the loud mixed-precision report, and saves the
+              converted artifact to O (meta.json carries the quant
+              block: mode, scales digest, calibration sample count —
+              stale-scale artifacts fail at load). --no-check skips
+              the fp-vs-quant output-delta check run
+  route--replica http://host:port [--replica ...] [--host H]
               [--port P] [--probe_interval_ms P] [--request_timeout_ms T]
               stand-alone router over ALREADY-RUNNING replica servers
               (the cross-host deployment: one route process in front
               of serve processes on other machines)
-  tune        --kernel K --shape k=v,k=v [--shape ...] [--dtype bf16|f32]
+  tune        --kernel K --shape k=v,k=v [--shape ...]
+              [--dtype bf16|f32|int8]
               [--dry-run] [--cache PATH] [--iters N] [--warmup N]
               [--search guided|exhaustive] [--budget FRAC] [--mesh dp4]
               | --config M.py [--dry-run ...]
@@ -95,7 +112,7 @@ Commands:
               lists candidates without timing (works on any backend;
               real timing requires TPU).
               Kernels: bahdanau (B,S,A,C), flash (Tq,Tk), conv
-              (n,cin,cout), lstm/gru (B,H).
+              (n,cin,cout), lstm/gru (B,H), quant (M,K,N — int8).
   tune export --out FILE [--cache PATH]
   tune import FILE [FILE...] [--cache PATH]
   tune merge  --out FILE IN1 [IN2...]
@@ -368,7 +385,7 @@ _SERVE_KNOWN = {
     "max_batch_size": str, "max_wait_ms": str, "max_queue": str,
     "timeout_ms": str, "seq_len_buckets": str, "warmup": str,
     "max_slots": str, "gen_queue": str, "gen_timeout_ms": str,
-    "trace_out": str, "mesh": str, "drain_s": str,
+    "trace_out": str, "mesh": str, "drain_s": str, "quant": str,
     # fleet mode (router + replica processes); NOT forwarded to the
     # replica children
     "replicas": str, "standby": str, "probe_interval_ms": str,
@@ -431,6 +448,7 @@ def _cmd_serve(argv) -> int:
     for name, d in models.items():
         engine, _ = registry.add(
             name, model_dir=d, policy=policy, mesh=mesh,
+            quantize=opts.get("quant") or None,
             max_wait_ms=float(opts.get("max_wait_ms", 5.0)),
             max_queue=int(opts.get("max_queue", 256)),
             timeout_ms=float(opts.get("timeout_ms", 2000.0)),
@@ -595,7 +613,7 @@ def _cmd_route(argv) -> int:
 
 _DTYPE_ALIASES = {"bf16": "bfloat16", "bfloat16": "bfloat16",
                   "f32": "float32", "fp32": "float32",
-                  "float32": "float32"}
+                  "float32": "float32", "int8": "int8", "i8": "int8"}
 
 
 def _fmt_cfg(cfg) -> str:
@@ -726,7 +744,7 @@ def _cmd_tune(argv) -> int:
         dp = dict(parse_mesh_spec(opts["mesh"])).get("dp", 1)
     dtype = _DTYPE_ALIASES.get(opts.get("dtype", "bf16"))
     if dtype is None:
-        raise SystemExit(f"--dtype must be bf16 or f32, got "
+        raise SystemExit(f"--dtype must be bf16, f32 or int8, got "
                          f"{opts['dtype']!r}")
 
     cases = []
@@ -834,6 +852,85 @@ def _cmd_tune(argv) -> int:
     return 0
 
 
+def _synthetic_samples(feed_specs, feed_names, n, batch=4):
+    """Deterministic calibration feeds from an artifact's feed specs:
+    seed-0 standard-normal floats / small-range ints, -1 dims pinned to
+    the calibration batch (dim 0) or 8 (inner dims). Synthetic ranges
+    are a stand-in for real traffic — good enough for the smoke path;
+    production calibration should feed recorded samples through
+    quant.calibrate directly."""
+    import numpy as np
+
+    rng = np.random.RandomState(0)
+    samples = []
+    for _ in range(n):
+        feed = {}
+        for name in feed_names:
+            spec = (feed_specs or {}).get(name)
+            if spec is None:
+                raise SystemExit(
+                    f"feed {name!r} has no shape/dtype spec in meta.json "
+                    "(pre-serving artifact?); re-export the model or "
+                    "calibrate programmatically via paddle_tpu.quant")
+            shape = [batch if i == 0 and d == -1 else (8 if d == -1 else d)
+                     for i, d in enumerate(spec["shape"])]
+            dtype = np.dtype(spec["dtype"])
+            if dtype.kind in "iu":
+                feed[name] = rng.randint(0, 8, size=shape).astype(dtype)
+            else:
+                feed[name] = rng.standard_normal(shape).astype(dtype)
+        samples.append(feed)
+    return samples
+
+
+def _cmd_quant(argv) -> int:
+    """Post-training int8 quantization of a saved inference artifact:
+    load → calibrate activation ranges on deterministic synthetic
+    samples → rewrite matmul sites to quantized kernels → save the
+    converted artifact (with the quant sidecar io.py validates at
+    load). The loud mixed-precision report goes to stdout."""
+    from . import io as pt_io
+    from . import quant
+    from .core.executor import Executor, Scope
+
+    no_check = False
+    argv = list(argv)
+    while "--no-check" in argv or "--no_check" in argv:
+        argv.remove("--no-check" if "--no-check" in argv
+                    else "--no_check")
+        no_check = True
+    known = {"model_dir": str, "out": str, "samples": str, "mode": str}
+    opts = _parse_kv(argv, known)
+    model_dir, out = opts.get("model_dir"), opts.get("out")
+    if not (model_dir and out):
+        raise SystemExit("quant requires --model_dir <dir> --out <dir>")
+    mode = opts.get("mode", "int8")
+    n_samples = int(opts.get("samples", 8))
+    scope = Scope()
+    exe = Executor()
+    program, feed_names, fetch_names = pt_io.load_inference_model(
+        model_dir, scope=scope)
+    if getattr(program, "_quant_meta", None):
+        raise SystemExit(f"{model_dir} is already quantized "
+                         f"({program._quant_meta.get('mode')})")
+    samples = _synthetic_samples(getattr(program, "_serving_meta", None),
+                                 feed_names, n_samples)
+    calib = quant.calibrate(program, samples, scope=scope, exe=exe)
+    check = None if no_check else samples[0]
+    try:
+        report = quant.convert(
+            program, scope=scope, calib=calib, mode=mode,
+            check_feed=check, fetch_list=fetch_names if check else None,
+            exe=exe)
+    except ValueError as e:
+        raise SystemExit(str(e))
+    print(report.summary())
+    pt_io.save_inference_model(out, feed_names, fetch_names,
+                               main_program=program, scope=scope)
+    print(f"quantized model written to {out}")
+    return 0
+
+
 def _cmd_stats(argv) -> int:
     """Scrape/parse a Prometheus exposition and print a summary: the
     consumer side of the unified metrics registry (obs.promparse is the
@@ -915,6 +1012,8 @@ def main(argv=None) -> int:
         return _cmd_route(rest)
     if cmd == "tune":
         return _cmd_tune(rest)
+    if cmd == "quant":
+        return _cmd_quant(rest)
     if cmd == "stats":
         return _cmd_stats(rest)
     if cmd == "flags":
@@ -926,7 +1025,7 @@ def main(argv=None) -> int:
         print(full_version)
         return 0
     raise SystemExit(f"unknown command {cmd!r}; try: train, merge_model, "
-                     "serve, route, tune, stats, flags, version")
+                     "serve, route, tune, quant, stats, flags, version")
 
 
 if __name__ == "__main__":

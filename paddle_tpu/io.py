@@ -40,6 +40,9 @@ from .resilience import faults
 
 __all__ = [
     "CheckpointCorruptError",
+    "QuantMetaError",
+    "program_fingerprint",
+    "quant_scales_digest",
     "save_vars",
     "save_params",
     "save_persistables",
@@ -67,6 +70,46 @@ CHECKPOINT_PREFIX = "checkpoint"
 class CheckpointCorruptError(RuntimeError):
     """A checkpoint's payload does not match the integrity record in its
     meta (or the payload is unreadable)."""
+
+
+class QuantMetaError(ValueError):
+    """A quantized artifact's quant sidecar does not match its payload:
+    the program changed after the scales were calibrated (stale-scale
+    artifact) or the int8 payload/scales were swapped out from under
+    the program. Serving such an artifact would produce garbage at full
+    throughput — fail at load instead."""
+
+
+def program_fingerprint(program: Program) -> str:
+    """Content hash of a program's serialized form (to_dict is already
+    the canonical round-trip surface, and version is deliberately NOT
+    part of it, so the fingerprint of a freshly-saved program equals
+    the fingerprint of its re-loaded self). The quant meta block pins
+    scales to this — a rewrite after calibration changes the hash."""
+    blob = json.dumps(program.to_dict(), sort_keys=True)
+    return hashlib.sha256(blob.encode()).hexdigest()[:16]
+
+
+def quant_scales_digest(scope: Scope, param_names: Sequence[str]) -> str:
+    """Digest over the quant-bearing payload of an artifact: every int8
+    parameter and every @quant_scale var, hashed with name/dtype/shape
+    so a scale swapped between two weights of the same size is still
+    caught. Calibration is deterministic (quant/calibrate.py), so equal
+    inputs produce equal digests."""
+    from .quant.convert import SCALE_SUFFIX
+
+    h = hashlib.sha256()
+    for name in sorted(param_names):
+        if not scope.has(name):
+            continue
+        a = np.asarray(scope.get(name))
+        if a.dtype != np.int8 and not name.endswith(SCALE_SUFFIX):
+            continue
+        h.update(name.encode())
+        h.update(str(a.dtype).encode())
+        h.update(str(a.shape).encode())
+        h.update(np.ascontiguousarray(a).tobytes())
+    return h.hexdigest()[:16]
 
 
 def _sha256_file(path: str) -> str:
@@ -319,6 +362,16 @@ def save_inference_model(
     # replica can BE a mesh — load_inference_model re-attaches the
     # specs and ServingEngine(mesh=...) places params accordingly
     sharding = _sharding_meta(pruned)
+    # quant sidecar (quant/convert.py sets _quant_meta): mode + site
+    # counts travel as-is; the program fingerprint and scales digest are
+    # computed HERE, over the pruned program and the params actually
+    # saved, so load-time validation checks the artifact's own content
+    quant = None
+    qmeta = getattr(program, "_quant_meta", None)
+    if qmeta:
+        quant = dict(qmeta)
+        quant["program_fingerprint"] = program_fingerprint(pruned)
+        quant["scales_digest"] = quant_scales_digest(scope, param_names)
     with open(os.path.join(dirname, PROGRAM_FILE), "w") as f:
         json.dump(pruned.to_dict(), f)
     with open(os.path.join(dirname, META_FILE), "w") as f:
@@ -331,6 +384,7 @@ def save_inference_model(
                 "tuning": tuning,
                 **({"generation": generation} if generation else {}),
                 **({"sharding": sharding} if sharding else {}),
+                **({"quant": quant} if quant else {}),
             },
             f,
         )
@@ -448,6 +502,27 @@ def load_inference_model(dirname: str, scope: Optional[Scope] = None):
     # mesh ServingEngine (or ParallelExecutor) places them sharded
     program._sharding_meta = meta.get("sharding") or None
     apply_sharding_meta(program, program._sharding_meta)
+    # quant sidecar (absent for fp artifacts): validate scales against
+    # the program BEFORE anything can serve — a stale-scale artifact
+    # (program edited after calibration, or payload swapped) fails
+    # loudly here instead of serving garbage at full throughput
+    program._quant_meta = meta.get("quant") or None
+    if program._quant_meta:
+        q = program._quant_meta
+        fp = program_fingerprint(program)
+        if q.get("program_fingerprint") not in (None, fp):
+            raise QuantMetaError(
+                f"{dirname}: quantized artifact is stale — the program "
+                f"({fp}) no longer matches the one its scales were "
+                f"calibrated for ({q['program_fingerprint']}); re-run "
+                "calibrate + convert and re-export")
+        digest = quant_scales_digest(scope, meta["param_names"])
+        if q.get("scales_digest") not in (None, digest):
+            raise QuantMetaError(
+                f"{dirname}: quantized payload/scales digest {digest} "
+                f"does not match the recorded {q['scales_digest']} — "
+                "the int8 weights or their scales were modified after "
+                "export; refusing to serve mismatched scales")
     return program, meta["feed_names"], meta["fetch_names"]
 
 

@@ -368,6 +368,37 @@ def _tune_families():
     ]
 
 
+def _quant_families():
+    """The int8 serving fast path's footprint (paddle_tpu.quant): how
+    many matmul sites run quantized, the weight bytes that stopped
+    streaming per request, and the convert-time accuracy-check delta.
+    Emits nothing until the process converts or loads a quantized
+    artifact — an fp-only process's scrape stays quant-silent."""
+    import sys
+
+    quant = sys.modules.get("paddle_tpu.quant")
+    if quant is None:
+        return []
+    st = quant.stats()
+    if not st:
+        return []
+    return [
+        ("pt_quant_sites_quantized", "gauge",
+         "matmul sites running the int8 quantized kernel (quant/)",
+         [(None, float(st["sites_quantized"]))]),
+        ("pt_quant_sites_skipped", "gauge",
+         "candidate sites left at higher precision by the converter",
+         [(None, float(st["sites_skipped"]))]),
+        ("pt_quant_bytes_saved", "gauge",
+         "weight bytes removed from the per-request HBM stream by int8 "
+         "storage (vs the original parameter dtype)",
+         [(None, float(st["bytes_saved"]))]),
+        ("pt_quant_accuracy_delta", "gauge",
+         "max |quantized - fp| output delta on the convert check feed",
+         [(None, float(st["accuracy_delta"]))]),
+    ]
+
+
 def _statset_families():
     """The global StatSet rides the unified render even though it is
     not attach_stat_set'ed (reset_metrics would drop the attachment;
@@ -392,4 +423,5 @@ def _statset_families():
 _REGISTRY.add_collector(_faults_families)
 _REGISTRY.add_collector(_trace_families)
 _REGISTRY.add_collector(_tune_families)
+_REGISTRY.add_collector(_quant_families)
 _REGISTRY.add_collector(_statset_families)

@@ -20,6 +20,7 @@ from . import nn_ops  # noqa: F401
 from . import flash_ops  # noqa: F401
 from . import fused_conv_ops  # noqa: F401
 from . import optimizer_ops  # noqa: F401
+from . import quant_kernels  # noqa: F401
 from . import recurrent_ops  # noqa: F401
 from . import rnn_ops  # noqa: F401
 from . import sequence_ops  # noqa: F401

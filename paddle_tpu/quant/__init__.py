@@ -1,0 +1,90 @@
+"""Post-training int8 quantization for inference artifacts.
+
+The low-precision serving fast path (ROADMAP item 2; ISSUE 15): serving
+is HBM-bandwidth-bound well below the MXU ceiling (PERF.md's MFU grid),
+so the highest-leverage byte to shave is the weight panel a matmul
+re-streams every request. The pass is the classic PTQ recipe:
+
+  1. `calibrate.calibrate(program, samples)` — run a sample feed
+     through the inference program, record per-tensor absmax ranges of
+     every quantizable matmul's activation input (weights get
+     per-output-channel ranges at convert time, straight off the
+     parameter value);
+  2. `convert.convert(program, scope, calib)` — rewrite in place:
+     weight payloads become int8 with f32 per-channel scale vars,
+     mul/matmul sites become quantized_* ops (ops/quant_kernels.py)
+     with a dequantize-on-the-fly epilogue; everything without a
+     quantized lowering (amp.precision_policy says "high", or no
+     weight to quantize) stays at its original precision — the result
+     is a MIXED program and the report says loudly what stayed fp;
+  3. `io.save_inference_model` — scales + quant mode land in the
+     meta.json "quant" block with a program fingerprint + scales
+     digest, so a stale-scale artifact fails LOUDLY at load instead of
+     serving garbage, and the artifact round-trips through the router
+     fleet / mesh sharding unchanged (it's just a program + params).
+
+Process-level quant state is exported as pt_quant_* gauges through the
+unified obs registry (obs/metrics._quant_families): bytes saved, sites
+quantized/skipped, and the convert-time accuracy-check delta.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+from .calibrate import CalibrationResult, calibrate, quantizable_sites
+from .convert import QuantReport, convert
+
+__all__ = ["CalibrationResult", "calibrate", "quantizable_sites",
+           "QuantReport", "convert", "stats", "note_convert",
+           "note_serving"]
+
+# process-level quant activity, rendered as pt_quant_* by the obs
+# registry collector (obs/metrics.py). Updated by convert() in the
+# converting process and by ServingEngine on loading a quantized
+# artifact (so a serving replica's /metrics shows the artifact's quant
+# footprint without having converted anything itself).
+_STATS: Dict[str, float] = {
+    "sites_quantized": 0,
+    "sites_skipped": 0,
+    "bytes_saved": 0,
+    "accuracy_delta": 0.0,
+}
+_ACTIVE = False
+
+
+def note_convert(report: "QuantReport") -> None:
+    global _ACTIVE
+    _ACTIVE = True
+    _STATS["sites_quantized"] += len(report.quantized)
+    _STATS["sites_skipped"] += len(report.skipped)
+    _STATS["bytes_saved"] += report.bytes_saved
+    if report.accuracy_delta is not None:
+        _STATS["accuracy_delta"] = float(report.accuracy_delta)
+
+
+def note_serving(meta: Optional[Dict[str, Any]]) -> None:
+    """Fold a loaded artifact's quant block into this process's gauges
+    (a serving replica advertises the quant footprint it dispatches)."""
+    global _ACTIVE
+    if not meta:
+        return
+    _ACTIVE = True
+    _STATS["sites_quantized"] += int(meta.get("sites", 0))
+    _STATS["bytes_saved"] += int(meta.get("bytes_saved", 0))
+    if meta.get("accuracy_delta") is not None:
+        _STATS["accuracy_delta"] = float(meta["accuracy_delta"])
+
+
+def stats() -> Dict[str, float]:
+    """Current pt_quant_* gauge values; empty dict = no quant activity
+    in this process (the collector then emits nothing)."""
+    return dict(_STATS) if _ACTIVE else {}
+
+
+def reset_stats() -> None:
+    """Test isolation."""
+    global _ACTIVE
+    _ACTIVE = False
+    for k in _STATS:
+        _STATS[k] = 0 if k != "accuracy_delta" else 0.0

@@ -1,0 +1,104 @@
+"""Calibration: record absmax ranges over a sample feed.
+
+Per-tensor ranges for matmul ACTIVATION inputs (the only runtime-valued
+side — it has to be observed), per-output-channel ranges for WEIGHTS
+(taken at convert time straight off the parameter, no run needed).
+Observation rides the executor's ordinary fetch path: the activation
+var names are appended to fetch_list, so calibration exercises exactly
+the compiled program serving will run — no shadow interpreter whose
+numerics could drift from production's.
+
+Determinism: absmax over a fixed sample list through a jitted program
+is bit-deterministic (tier-1 pins it), so calibrating twice from the
+same feed yields byte-identical scales — which is what lets the scales
+digest in meta.json double as a staleness check.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .. import amp
+from ..core.executor import Executor, Scope, global_scope
+
+
+def quantizable_sites(program, scope: Optional[Scope] = None
+                      ) -> List[Dict[str, Any]]:
+    """The matmul sites the converter MAY rewrite: op type passes the
+    shared precision policy (amp.QUANTIZABLE_OPS — the one table both
+    passes read), the weight side is a persistable 2-D parameter
+    present in the scope, and no transpose lands on the activation.
+    Returns [{block, op_idx, op, x, w, transpose_w}]."""
+    scope = scope or global_scope()
+    sites = []
+    for bi, block in enumerate(program.blocks):
+        for oi, op in enumerate(block.ops):
+            if op.type not in amp.QUANTIZABLE_OPS:
+                continue
+            if amp.precision_policy(op.type) != "low":
+                continue  # policy table is authoritative, not op list
+            xs = op.inputs.get("X", [])
+            ys = op.inputs.get("Y", [])
+            if len(xs) != 1 or len(ys) != 1:
+                continue
+            try:
+                wv = block.var(ys[0])
+            except KeyError:
+                continue
+            if not wv.persistable or not scope.has(ys[0]):
+                continue  # activation×activation matmul: nothing stored
+            w = np.asarray(scope.get(ys[0]))
+            if w.ndim != 2:
+                continue
+            if op.type == "matmul" and op.attrs.get("transpose_X"):
+                continue
+            sites.append({
+                "block": bi, "op_idx": oi, "op": op,
+                "x": xs[0], "w": ys[0],
+                "transpose_w": bool(op.attrs.get("transpose_Y", False)),
+            })
+    return sites
+
+
+class CalibrationResult:
+    """absmax ranges from one calibration run.
+
+    act_ranges: activation var name -> float absmax (per-tensor);
+    sample_count: how many sample feeds contributed (meta.json records
+    it so an artifact calibrated on 2 samples is visibly different from
+    one calibrated on 2000)."""
+
+    def __init__(self, act_ranges: Dict[str, float], sample_count: int):
+        self.act_ranges = dict(act_ranges)
+        self.sample_count = int(sample_count)
+
+    def __repr__(self):
+        return (f"CalibrationResult({len(self.act_ranges)} tensors, "
+                f"{self.sample_count} samples)")
+
+
+def calibrate(program, samples: Sequence[Dict[str, Any]],
+              scope: Optional[Scope] = None,
+              exe: Optional[Executor] = None) -> CalibrationResult:
+    """Run `samples` (a sequence of feed dicts) through the inference
+    program and record per-tensor absmax of every quantizable site's
+    activation input. The fetches ride the ordinary executor path, so
+    ranges are observed on the exact compiled numerics serving uses."""
+    if not samples:
+        raise ValueError("calibrate() needs at least one sample feed")
+    scope = scope or global_scope()
+    exe = exe or Executor()
+    sites = quantizable_sites(program, scope)
+    act_names = sorted({s["x"] for s in sites})
+    ranges: Dict[str, float] = {n: 0.0 for n in act_names}
+    if act_names:
+        for feed in samples:
+            outs = exe.run(program, feed=dict(feed),
+                           fetch_list=list(act_names), scope=scope)
+            for name, val in zip(act_names, outs):
+                amax = float(np.max(np.abs(np.asarray(val, np.float32))))
+                if amax > ranges[name]:
+                    ranges[name] = amax
+    return CalibrationResult(ranges, len(samples))

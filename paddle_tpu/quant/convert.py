@@ -1,0 +1,196 @@
+"""Program rewrite: fp matmul sites → quantized int8 ops.
+
+In-place pass over (program, scope): weight payloads are re-stored as
+int8 with an f32 per-output-channel scale var (`<w>@quant_scale`,
+persistable — it travels in params.npz like any parameter), and each
+eligible site becomes a quantized_mul/quantized_matmul op whose
+dequantize epilogue runs inside the kernel (ops/quant_kernels.py). The
+activation scale is CALIBRATED (calibrate.py absmax / 127) and baked as
+a JSON-safe float attr — per-channel scale ARRAYS can't live in op
+attrs (core/program._json_safe drops them from program.json), which is
+exactly why weight scales are scope vars instead.
+
+The result is deliberately a MIXED-precision program: anything the
+shared policy table (amp.precision_policy — ONE table for amp and
+quant) marks "high", anything without a persistable 2-D weight, and
+anything whose site fails an eligibility check stays at its original
+precision. QuantReport.summary() names every survivor loudly; a silent
+partial quantization would make the bench's bytes-saved claim a lie.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from .. import amp
+from ..core.executor import Executor, Scope, global_scope
+from ..ops import quant_kernels as qk
+from .calibrate import CalibrationResult, quantizable_sites
+
+SCALE_SUFFIX = "@quant_scale"
+
+_QUANT_OP = {"mul": "quantized_mul", "matmul": "quantized_matmul"}
+
+
+class QuantReport:
+    """What the converter did — and, loudly, what it did NOT."""
+
+    def __init__(self, mode: str, quantized: List[Dict[str, Any]],
+                 skipped: List[Dict[str, Any]], kept_fp_ops: int,
+                 bytes_saved: int, sample_count: int,
+                 accuracy_delta: Optional[float] = None):
+        self.mode = mode
+        self.quantized = quantized
+        self.skipped = skipped
+        self.kept_fp_ops = kept_fp_ops
+        self.bytes_saved = bytes_saved
+        self.sample_count = sample_count
+        self.accuracy_delta = accuracy_delta
+
+    def meta(self) -> Dict[str, Any]:
+        """The artifact sidecar payload (io.save_inference_model adds
+        the program fingerprint + scales digest at save time)."""
+        return {
+            "mode": self.mode,
+            "sites": len(self.quantized),
+            "skipped": len(self.skipped),
+            "calibration_samples": self.sample_count,
+            "bytes_saved": int(self.bytes_saved),
+            **({"accuracy_delta": float(self.accuracy_delta)}
+               if self.accuracy_delta is not None else {}),
+        }
+
+    def summary(self) -> str:
+        lines = [
+            f"quantized {len(self.quantized)} matmul sites to "
+            f"{self.mode} ({self.bytes_saved / 1024:.1f} KiB of weight "
+            f"bytes saved; calibrated on {self.sample_count} samples)"]
+        for q in self.quantized:
+            lines.append(
+                f"  {q['op']}: {q['w']} [{q['K']}x{q['N']}] int8 "
+                f"per-channel, x_scale={q['x_scale']:.3g}")
+        if self.skipped:
+            lines.append(
+                f"  LEFT AT HIGHER PRECISION ({len(self.skipped)} "
+                "candidate sites — mixed-precision program):")
+            for s in self.skipped:
+                lines.append(f"    {s['op']}: {s['reason']}")
+        lines.append(
+            f"  {self.kept_fp_ops} non-matmul ops keep their original "
+            "precision (amp.precision_policy: high/follow)")
+        if self.accuracy_delta is not None:
+            lines.append(
+                f"  accuracy check: max |quant - fp| = "
+                f"{self.accuracy_delta:.4g} on the check feed")
+        return "\n".join(lines)
+
+
+def _site_skip_reason(site, calib: CalibrationResult,
+                      quantized_layout: Dict[str, str]) -> Optional[str]:
+    x, w = site["x"], site["w"]
+    if x not in calib.act_ranges:
+        return f"activation {x!r} has no calibration range"
+    if calib.act_ranges[x] <= 0.0:
+        return (f"activation {x!r} calibrated to absmax 0 (dead input "
+                "on the sample feed)")
+    layout = "NK" if site["transpose_w"] else "KN"
+    if w in quantized_layout and quantized_layout[w] != layout:
+        return (f"weight {w!r} already quantized with layout "
+                f"{quantized_layout[w]} (shared across transposed "
+                "sites)")
+    return None
+
+
+def convert(program, scope: Optional[Scope] = None,
+            calib: Optional[CalibrationResult] = None,
+            mode: str = "int8",
+            check_feed: Optional[Dict[str, Any]] = None,
+            fetch_list: Optional[List[str]] = None,
+            exe: Optional[Executor] = None) -> QuantReport:
+    """Rewrite `program`/`scope` IN PLACE to the quantized form.
+
+    check_feed (optional, with fetch_list): runs the program before and
+    after the rewrite on that feed and records the max output delta —
+    the accuracy number meta.json and the pt_quant_accuracy_delta gauge
+    report. Returns the QuantReport; raises if nothing was quantizable
+    (an all-skip convert is an operator error, not a quiet no-op)."""
+    if mode != "int8":
+        raise ValueError(f"unsupported quant mode {mode!r} (only int8)")
+    scope = scope or global_scope()
+    if calib is None:
+        raise ValueError("convert() needs a CalibrationResult "
+                         "(quant.calibrate the sample feed first)")
+    exe = exe or Executor()
+    ref_outs = None
+    if check_feed is not None:
+        if not fetch_list:
+            raise ValueError("check_feed needs fetch_list to compare on")
+        ref_outs = exe.run(program, feed=dict(check_feed),
+                           fetch_list=list(fetch_list), scope=scope)
+
+    sites = quantizable_sites(program, scope)
+    quantized: List[Dict[str, Any]] = []
+    skipped: List[Dict[str, Any]] = []
+    quantized_layout: Dict[str, str] = {}
+    bytes_saved = 0
+    for site in sites:
+        op, block = site["op"], program.blocks[site["block"]]
+        reason = _site_skip_reason(site, calib, quantized_layout)
+        if reason is not None:
+            skipped.append({"op": op.type, "w": site["w"],
+                            "reason": reason})
+            continue
+        wname = site["w"]
+        layout = "NK" if site["transpose_w"] else "KN"
+        scale_name = wname + SCALE_SUFFIX
+        if wname not in quantized_layout:
+            w = np.asarray(scope.get(wname))
+            orig_nbytes = w.size * w.dtype.itemsize
+            if site["transpose_w"]:
+                w = np.ascontiguousarray(w.T)
+            wq, scale = qk.quantize_weight(w)
+            scope.set(wname, wq)
+            scope.set(scale_name, scale)
+            wv = block.var(wname)
+            wv.dtype = np.int8
+            wv.shape = tuple(wq.shape)
+            block.create_var(scale_name, shape=(wq.shape[1],),
+                             dtype=np.float32, persistable=True)
+            quantized_layout[wname] = layout
+            bytes_saved += orig_nbytes - (wq.size + scale.size * 4)
+        x_scale = qk.act_scale(calib.act_ranges[site["x"]])
+        op.type = _QUANT_OP[op.type]
+        op.inputs["Scale"] = [scale_name]
+        op.attrs.pop("transpose_Y", None)
+        op.attrs["x_scale"] = x_scale
+        op.attrs["quant_mode"] = mode
+        K, N = block.var(wname).shape
+        quantized.append({"op": op.type, "x": site["x"], "w": wname,
+                          "K": int(K), "N": int(N), "x_scale": x_scale})
+    if not quantized:
+        raise ValueError(
+            "convert(): no site was quantizable — " + "; ".join(
+                f"{s['op']}: {s['reason']}" for s in skipped) if skipped
+            else "convert(): the program has no quantizable matmul sites")
+    program.bump_version()
+
+    kept_fp = sum(1 for b in program.blocks for o in b.ops
+                  if o.type not in _QUANT_OP.values())
+    accuracy_delta = None
+    if ref_outs is not None:
+        q_outs = exe.run(program, feed=dict(check_feed),
+                         fetch_list=list(fetch_list), scope=scope)
+        accuracy_delta = max(
+            float(np.max(np.abs(np.asarray(a, np.float32)
+                                - np.asarray(b, np.float32))))
+            for a, b in zip(ref_outs, q_outs))
+    report = QuantReport(mode, quantized, skipped, kept_fp, bytes_saved,
+                         calib.sample_count, accuracy_delta)
+    program._quant_meta = report.meta()
+
+    from . import note_convert
+
+    note_convert(report)
+    return report

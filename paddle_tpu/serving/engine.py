@@ -55,6 +55,11 @@ from .metrics import MetricSet
 
 __all__ = ["BucketPolicy", "ServingEngine"]
 
+# stale-table warning / coverage naming renders every family the engine
+# can dispatch, INCLUDING the quantized one — short dtype aliases for
+# the `paddle_tpu tune` command it prints
+_DTYPE_SHORT = {"bfloat16": "bf16", "float32": "f32", "int8": "int8"}
+
 
 def _pow2_buckets(max_batch_size: int) -> Tuple[int, ...]:
     out, b = [], 1
@@ -135,6 +140,7 @@ class ServingEngine:
         metrics: Optional[MetricSet] = None,
         mesh=None,
         batch_axis: Optional[str] = None,
+        quantize: Optional[str] = None,
     ):
         self.model_name = model_name
         self.policy = policy or BucketPolicy()
@@ -142,6 +148,36 @@ class ServingEngine:
         self.program, self.feed_names, self.fetch_names = (
             load_inference_model(model_dir, scope=self.scope)
         )
+        # low-precision fast path (quant/): `quantize="int8"` asserts
+        # the artifact IS a converted one (quant sidecar present —
+        # load_inference_model already validated scales against the
+        # program) rather than quietly serving the fp program at fp
+        # cost. A quantized artifact also serves fine WITHOUT the knob:
+        # it is just a program + params; the knob is the operator's
+        # declared intent, so a misrouted fp artifact fails here.
+        self.quant_meta = getattr(self.program, "_quant_meta", None)
+        self.quantize = quantize
+        if quantize is not None:
+            if quantize != "int8":
+                raise ValueError(
+                    f"unsupported quantize mode {quantize!r} (only "
+                    "'int8')")
+            if not self.quant_meta:
+                raise ValueError(
+                    f"model {model_name!r}: quantize='int8' requested "
+                    f"but {model_dir} carries no quant sidecar — run "
+                    "`paddle_tpu quant --model_dir <fp artifact> --out "
+                    "<dir>` and serve the converted artifact")
+            if self.quant_meta.get("mode") != quantize:
+                raise ValueError(
+                    f"model {model_name!r}: artifact was quantized as "
+                    f"{self.quant_meta.get('mode')!r}, not {quantize!r}")
+        if self.quant_meta:
+            # the replica's /metrics advertises the quant footprint it
+            # dispatches (pt_quant_* via the obs registry collector)
+            from .. import quant as _quant
+
+            _quant.note_serving(self.quant_meta)
         # mesh-sharded replica (scale-out serving): with `mesh` given,
         # the engine runs over ParallelExecutor — parameters carrying a
         # partition spec (restored by load_inference_model from the
@@ -495,7 +531,7 @@ class ServingEngine:
             + "; ".join(
                 f"`paddle_tpu tune --kernel {c['family']} --shape "
                 f"{c['sig']} --dtype "
-                f"{'bf16' if c['dtype'] == 'bfloat16' else 'f32'}`"
+                f"{_DTYPE_SHORT.get(c['dtype'], c['dtype'])}`"
                 for c in (untuned or interp)[:2]))
         return "\n  " + "\n  ".join(lines)
 
@@ -652,6 +688,34 @@ class ServingEngine:
                     out.append({"family": "flash_attention",
                                 "params": {"Tq": s[1], "Tk": k[1]},
                                 "dtype": amp, "op": op.type})
+                elif op.type in ("quantized_mul", "quantized_matmul"):
+                    # int8 sites (quant/convert.py): the weight panel
+                    # [K, N] is static, the row count is the batch
+                    # bucket times any concrete inner leading dims — a
+                    # shape the offline sweep cannot know, so expand it
+                    # over the live bucket grid like the decode sites.
+                    # Without this the stale-table warning named only
+                    # the fp kernel shapes and `paddle_tpu stats`
+                    # undercounted tuned coverage on quantized models.
+                    w = var_shape(block, op.inputs["Y"][0])
+                    x = var_shape(block, op.inputs["X"][0])
+                    if not w or len(w) != 2 or min(w) <= 0 or not x:
+                        continue
+                    xd = int(op.attrs.get("x_num_col_dims", 1))
+                    inner = x[1:xd]
+                    if any(d <= 0 for d in inner):
+                        continue
+                    mult = 1
+                    for d in inner:
+                        mult *= d
+                    for nb in self.policy.batch_buckets:
+                        if nb % dp:
+                            continue  # ragged shard: runtime falls back
+                        out.append({
+                            "family": "quant_matmul",
+                            "params": {"M": (nb // dp) * mult,
+                                       "K": w[0], "N": w[1]},
+                            "dtype": "int8", "op": op.type})
         # dedupe (several buckets/ops can land on one shape signature)
         seen, uniq = set(), []
         for c in out:
@@ -735,6 +799,15 @@ class ServingEngine:
                 "bucket_counts": {
                     str(k[1]): c for k, c in self._seen_buckets.items()
                 },
+                **({"quant": {
+                    "mode": self.quant_meta.get("mode"),
+                    "sites": self.quant_meta.get("sites"),
+                    "bytes_saved": self.quant_meta.get("bytes_saved"),
+                    **({"accuracy_delta":
+                        self.quant_meta["accuracy_delta"]}
+                       if self.quant_meta.get("accuracy_delta")
+                       is not None else {}),
+                }} if self.quant_meta else {}),
                 **({"mesh": {
                     "axes": {str(a): int(self.mesh.shape[a])
                              for a in self.mesh.axis_names},

@@ -84,6 +84,7 @@ class ModelRegistry:
         breaker: Optional[CircuitBreaker] = None,
         scheduler_kw: Optional[dict] = None,
         mesh=None,
+        quantize: Optional[str] = None,
         **batcher_kw,
     ) -> Tuple[ServingEngine, MicroBatcher]:
         if engine is None:
@@ -91,7 +92,7 @@ class ModelRegistry:
                 raise ValueError("add() needs model_dir or engine")
             engine = ServingEngine(model_dir, policy=policy,
                                    model_name=name, metrics=self.metrics,
-                                   mesh=mesh)
+                                   mesh=mesh, quantize=quantize)
         if batcher is None:
             # every registry-built model gets a circuit breaker: a model
             # whose engine keeps failing must 503 fast, not queue-then-500

@@ -122,12 +122,36 @@ def _features_rnn(kind: str):
     return f
 
 
+def _features_quant_matmul(params: Dict[str, Any], cfg: Config):
+    """int8 GEMM features — also the bench's CPU proxy for the serving
+    fast path: with dtype 'int8' the x/w panels stream at 1 B/elem
+    (plus the f32 dequant epilogue write); the SAME formula at a float
+    dtype models the unquantized matmul the site replaced, so
+    bench.py's HBM-bytes-per-request ratio (BENCH_MODEL=serving_quant)
+    is one feature function evaluated at two itemsizes."""
+    M, K, N = params["M"], params["K"], params["N"]
+    item = _FEATURE_ITEMSIZE.get(params.get("dtype", "int8"), 1)
+    bm = int(cfg.get("block_m", M) or M)
+    bn = int(cfg.get("block_n", N) or N)
+    gm, gn = M // max(1, bm), N // max(1, bn)
+    grid = gm * gn
+    # x panel re-streams per n-block, w panel per m-block; the output
+    # writes once — int32 accumulator materialized at 4 B then scaled
+    hbm = gn * M * K * item + gm * K * N * item + M * N * 4
+    ws = 2 * (bm * K + K * bn) * item + bm * bn * 4
+    return hbm, grid, ws
+
+
+_FEATURE_ITEMSIZE = {"int8": 1, "bfloat16": 2, "float32": 4}
+
+
 _FEATURES: Dict[str, Callable] = {
     "bahdanau_attention": _features_bahdanau,
     "flash_attention": _features_flash,
     "fused_conv": _features_conv,
     "fused_lstm": _features_rnn("lstm"),
     "fused_gru": _features_rnn("gru"),
+    "quant_matmul": _features_quant_matmul,
 }
 
 

@@ -46,11 +46,18 @@ def pad_s(s: int) -> int:
 def _dtype_of(name: str):
     import jax.numpy as jnp
 
-    return {"bfloat16": jnp.bfloat16, "float32": jnp.float32}[name]
+    return {"bfloat16": jnp.bfloat16, "float32": jnp.float32,
+            "int8": jnp.int8}[name]
+
+
+# io dtypes the spaces can key on: int8 joined with the quantized-matmul
+# family (the serving fast path) — tuned int8 is just another column of
+# the same per-device table.
+DTYPES = ("bfloat16", "float32", "int8")
 
 
 def _itemsize(dtype_name: str) -> int:
-    return {"bfloat16": 2, "float32": 4}[dtype_name]
+    return {"bfloat16": 2, "float32": 4, "int8": 1}[dtype_name]
 
 
 # ------------------------------------------------------------- bahdanau --
@@ -329,6 +336,89 @@ def _rnn_default(kind: str):
     return default
 
 
+# ----------------------------------------------------------- quant matmul --
+# Output-tile grids for the int8 GEMM: block_m walks the int8 sublane
+# tile (32 — Mosaic's (32, 128) minimum int8 tile, pallas guide), block_n
+# the 128 lane dim.
+QUANT_BLOCK_M = (32, 64, 128, 256, 512)
+QUANT_BLOCK_N = (128, 256, 512, 1024)
+
+
+def quant_matmul_legal(bm: int, bn: int, M: int, K: int, N: int) -> bool:
+    """Tile legality of the int8×int8→int32 kernel
+    (ops/quant_kernels._quant_matmul_pallas): blocks divide the output,
+    respect int8's (32, 128) minimum tile (unless spanning the whole
+    dim), and the working set — double-buffered int8 x/w panels plus
+    the int32 accumulator block — fits VMEM."""
+    if bm <= 0 or bn <= 0 or M % bm or N % bn:
+        return False
+    if bm % 32 and bm != M:
+        return False
+    if bn % 128 and bn != N:
+        return False
+    ws = 2 * (bm * K + K * bn) * 1 + bm * bn * 4
+    return ws <= _vmem_budget()
+
+
+def quant_matmul_candidates(params: Params) -> List[Config]:
+    M, K, N = params["M"], params["K"], params["N"]
+    # M and N themselves join the grids so shapes below the minimum
+    # tile (e.g. a batch-1 bucket) still have the whole-dim candidate
+    ms = sorted({b for b in (*QUANT_BLOCK_M, M) if M % b == 0})
+    ns = sorted({b for b in (*QUANT_BLOCK_N, N) if N % b == 0})
+    return [{"block_m": bm, "block_n": bn}
+            for bm in ms for bn in ns
+            if quant_matmul_legal(bm, bn, M, K, N)]
+
+
+def quant_matmul_default(params: Params) -> Optional[Config]:
+    """Analytic choice of the runtime fallback: the largest legal
+    output tile (fewest grid steps — the int8 panels are small enough
+    that dispatch overhead, not VMEM, dominates at serving shapes)."""
+    M, K, N = params["M"], params["K"], params["N"]
+    best = None
+    for bm in sorted({*QUANT_BLOCK_M, M}, reverse=True):
+        if M % bm:
+            continue
+        for bn in sorted({*QUANT_BLOCK_N, N}, reverse=True):
+            if N % bn:
+                continue
+            if quant_matmul_legal(bm, bn, M, K, N):
+                return {"block_m": bm, "block_n": bn}
+    return best
+
+
+def _quant_case(params: Params, dtype: str) -> "Case":
+    import numpy as np
+
+    import jax
+
+    from ..ops import quant_kernels as qk
+
+    M, K, N = params["M"], params["K"], params["N"]
+    rng = np.random.RandomState(0)
+    import jax.numpy as jnp
+
+    xq = jnp.asarray(rng.randint(-127, 128, (M, K)), jnp.int8)
+    wq = jnp.asarray(rng.randint(-127, 128, (K, N)), jnp.int8)
+    args = (xq, wq)
+
+    def make(config: Config) -> Callable[[], Any]:
+        from . import overrides
+
+        jf = jax.jit(lambda x, w: qk.quant_matmul(x, w))
+        with overrides.forcing("quant_matmul", config):
+            jf(*args)
+        return lambda: jf(*args)
+
+    def ref():
+        return [np.asarray(qk._quant_matmul_ref(xq, wq), np.int64)
+                .astype(np.float32)]
+
+    # integer contraction: every candidate must be EXACT, not close
+    return Case("quant_matmul", make, ref, tol=0.0)
+
+
 # --------------------------------------------------------------- registry --
 class Case:
     """A runnable tuning case: `make(config)` returns a zero-arg
@@ -356,9 +446,9 @@ class KernelSpace:
     def normalize(self, params: Params, dtype: str) -> Params:
         """Validated, canonically-ordered params incl. dtype — the shape
         signature the cache keys on."""
-        if dtype not in ("bfloat16", "float32"):
-            raise ValueError(f"{self.name}: dtype must be bfloat16 or "
-                             f"float32, got {dtype!r}")
+        if dtype not in DTYPES:
+            raise ValueError(f"{self.name}: dtype must be one of "
+                             f"{DTYPES}, got {dtype!r}")
         missing = [k for k in self.param_names if k not in params]
         if missing:
             raise ValueError(
@@ -403,11 +493,17 @@ FAMILIES: Dict[str, KernelSpace] = {
         "fused_gru", ("B", "H"),
         _rnn_candidates("gru"), _rnn_default("gru"),
         doc="fused-vs-scan dispatch of the whole-sequence GRU kernel"),
+    "quant_matmul": KernelSpace(
+        "quant_matmul", ("M", "K", "N"),
+        quant_matmul_candidates, quant_matmul_default, _quant_case,
+        doc="output tile (block_m, block_n) of the int8×int8→int32 "
+            "quantized-matmul kernel"),
 }
 
 ALIASES = {"bahdanau": "bahdanau_attention", "attention": "bahdanau_attention",
            "flash": "flash_attention", "conv": "fused_conv",
-           "lstm": "fused_lstm", "gru": "fused_gru"}
+           "lstm": "fused_lstm", "gru": "fused_gru",
+           "quant": "quant_matmul", "int8": "quant_matmul"}
 
 
 def get_family(name: str) -> KernelSpace:
@@ -512,6 +608,28 @@ def cases_from_program(program=None, dp: int = 1) -> List[Dict[str, Any]]:
                             "params": {"B": h0[0] // dp, "Sp": pad_s(src),
                                        "A": wa[1], "C": enc[-1]},
                             "dtype": amp_dt, "op": op.type})
+            elif op.type in ("quantized_mul", "quantized_matmul"):
+                # int8 sites (quant/convert.py rewrite): the weight
+                # panel [K, N] is static; the row count comes from X
+                # when concrete (serving buckets expand the -1 case via
+                # engine.decode_tune_cases)
+                x = var_shape(block, op.inputs["X"][0])
+                w = var_shape(block, op.inputs["Y"][0])
+                if not x or not w or len(w) != 2 or min(w) <= 0:
+                    continue
+                xd = int(op.attrs.get("x_num_col_dims", 1))
+                lead = x[:xd]
+                if any(d <= 0 for d in lead):
+                    continue
+                m = 1
+                for d in lead:
+                    m *= d
+                if m % dp:
+                    continue
+                out.append({"family": "quant_matmul",
+                            "params": {"M": m // dp, "K": w[0],
+                                       "N": w[1]},
+                            "dtype": "int8", "op": op.type})
             # dynamic_lstm/dynamic_gru sites are LoD-batched: their
             # runtime batch is not static in the program, so the model
             # sweep skips them — tune those via --kernel lstm/gru with

@@ -209,6 +209,55 @@ def test_cli_serve_end_to_end(tmp_path):
         proc.wait(timeout=30)
 
 
+def test_cli_quant_end_to_end(tmp_path):
+    """`quant` converts a saved fp32 artifact to int8: loud report on
+    stdout, converted artifact carries the quant sidecar, serves the
+    same shapes, and re-quantizing an already-quantized dir errors."""
+    import json
+
+    import paddle_tpu as pt
+
+    pt.reset()
+    pt.default_startup_program().random_seed = 2
+    x = pt.layers.data("x", shape=[8])
+    h = pt.layers.fc(x, size=16, act="relu")
+    pred = pt.layers.fc(h, size=4)
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    model_dir = str(tmp_path / "fp32")
+    pt.io.save_inference_model(model_dir, ["x"], [pred])
+
+    out_dir = str(tmp_path / "int8")
+    r = _run(["quant", "--model_dir", model_dir, "--out", out_dir,
+              "--samples", "4"], str(tmp_path))
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "quantized 2 matmul sites to int8" in r.stdout
+    assert "accuracy check" in r.stdout
+    assert f"quantized model written to {out_dir}" in r.stdout
+    with open(os.path.join(out_dir, "meta.json")) as f:
+        meta = json.load(f)
+    assert meta["quant"]["mode"] == "int8"
+    assert meta["quant"]["sites"] == 2
+    assert meta["quant"]["calibration_samples"] == 4
+    assert meta["quant"]["program_fingerprint"]
+    assert meta["quant"]["scales_digest"]
+    # converted artifact serves in-process (sidecar validates at load)
+    eng = pt.serving.ServingEngine(out_dir, quantize="int8")
+    out = eng.predict({"x": np.ones((2, 8), np.float32)})
+    assert np.asarray(out[0]).shape == (2, 4)
+    # double-quantization is an operator error
+    r2 = _run(["quant", "--model_dir", out_dir,
+               "--out", str(tmp_path / "int8x2")], str(tmp_path))
+    assert r2.returncode != 0
+    assert "already quantized" in (r2.stderr + r2.stdout)
+
+
+def test_cli_quant_requires_dirs(tmp_path):
+    r = _run(["quant", "--samples", "4"], str(tmp_path))
+    assert r.returncode != 0
+    assert "--model_dir" in (r.stderr + r.stdout)
+
+
 def test_cli_tune_dry_run(tmp_path):
     """`tune --dry-run` lists legal candidates for at least two kernel
     families on any backend (no timing, no TPU)."""
