@@ -58,7 +58,10 @@ Commands:
               [--timeout_ms T] [--seq_len_buckets 64,128,...] [--warmup 0|1]
               [--max_slots S] [--gen_queue Q] [--gen_timeout_ms T]
               [--mesh dp1,mp2] [--drain_s S] [--quant int8]
-              [--replicas N [--standby K] [--probe_interval_ms P]]
+              [--slo model=interactive|batch ...]
+              [--replicas N [--standby K] [--probe_interval_ms P]
+               [--autoscale --min_replicas A --max_replicas B
+                --cooldown_s C]]
               batching HTTP inference server over saved inference
               models (paddle_tpu.serving): /predict, /healthz, /metrics
               — generation models additionally serve /generate
@@ -73,7 +76,26 @@ Commands:
               warmed spares), join-shortest-queue balances /predict
               and /generate over them (streaming passes through),
               retries shed/503s on another replica, circuit-breaks and
-              replaces dead replicas (paddle_tpu.serving.router)
+              replaces dead replicas (paddle_tpu.serving.router).
+              --slo model=batch marks a model's traffic as the
+              sheddable tier: at queue pressure batch requests shed
+              strictly before interactive ones ever queue behind them,
+              and the router JSQ-scores picks per class
+              (paddle_tpu.fleetctl.tenancy; a request may self-demote
+              via X-PT-SLO-Class or "slo" in the body).
+              --autoscale arms the control loop: warm standbys are
+              promoted under sustained queue/occupancy pressure and
+              idle replicas drained + retired, between --min_replicas
+              and --max_replicas, with --cooldown_s between actions
+              (paddle_tpu.fleetctl.autoscaler; watch /admin/fleet)
+  fleetctl    rollout --router URL --model_dir D [--model NAME]
+              | status --router URL
+              control-plane client for a serve --replicas router:
+              rollout = zero-downtime version flip (warm new artifact
+              in fresh replicas, verify the program fingerprint from
+              meta.json on /healthz, atomically flip the router, drain
+              the old version); status = router + fleet + autoscaler
+              state in one JSON doc (GET /admin/fleet)
               --quant int8 asserts the artifact is a quantized one
               (see `quant` below) and serves its low-precision fast
               path; an fp artifact fails loudly instead of silently
@@ -353,6 +375,11 @@ def _parse_kv(argv, known):
         name = name[2:].replace("-", "_")
         if name not in known:
             raise SystemExit(f"unknown option --{name}")
+        if known[name] is bool:
+            # bare flag: --autoscale (or explicit --autoscale=0)
+            opts[name] = val if eq else "1"
+            i += 1
+            continue
         if not eq:
             if i + 1 >= len(argv):
                 raise SystemExit(f"option --{name} requires a value")
@@ -386,12 +413,21 @@ _SERVE_KNOWN = {
     "timeout_ms": str, "seq_len_buckets": str, "warmup": str,
     "max_slots": str, "gen_queue": str, "gen_timeout_ms": str,
     "trace_out": str, "mesh": str, "drain_s": str, "quant": str,
+    # multi-tenancy: per-model SLO class specs (model=interactive|batch);
+    # forwarded to replica children so admission tiers match the
+    # router's per-class picks
+    "slo": list,
     # fleet mode (router + replica processes); NOT forwarded to the
     # replica children
     "replicas": str, "standby": str, "probe_interval_ms": str,
+    # fleet control plane (fleetctl.autoscaler): warm-standby
+    # promotion under pressure, drain-and-retire when idle
+    "autoscale": bool, "min_replicas": str, "max_replicas": str,
+    "cooldown_s": str,
 }
 _FLEET_ONLY = ("replicas", "standby", "probe_interval_ms", "host",
-               "port", "trace_out")
+               "port", "trace_out", "autoscale", "min_replicas",
+               "max_replicas", "cooldown_s")
 
 
 def _cmd_serve(argv) -> int:
@@ -444,7 +480,10 @@ def _cmd_serve(argv) -> int:
         "max_queue": int(opts.get("gen_queue", 64)),
         "timeout_ms": float(opts.get("gen_timeout_ms", 30000.0)),
     }
-    registry = ModelRegistry()
+    from .fleetctl.tenancy import SLOPolicy
+
+    registry = ModelRegistry(
+        slo_policy=SLOPolicy.from_specs(opts.get("slo", [])))
     for name, d in models.items():
         engine, _ = registry.add(
             name, model_dir=d, policy=policy, mesh=mesh,
@@ -521,6 +560,7 @@ def _cmd_serve(argv) -> int:
 
 def _serve_fleet(opts) -> int:
     """serve --replicas N: router + pre-forked replica fleet."""
+    from .fleetctl.tenancy import SLOPolicy
     from .serving.router import Fleet, Router, make_router_server, \
         replica_spawner
 
@@ -540,18 +580,44 @@ def _serve_fleet(opts) -> int:
     n = int(opts["replicas"])
     standby = int(opts.get("standby", 0))
     router = Router(
-        probe_interval_s=float(opts.get("probe_interval_ms", 500)) / 1e3)
+        probe_interval_s=float(opts.get("probe_interval_ms", 500)) / 1e3,
+        slo_policy=SLOPolicy.from_specs(opts.get("slo", [])))
     fleet = Fleet(replica_spawner(child_args), replicas=n,
                   standby=standby, router=router)
+
+    # rollout hook: model_dir -> spawn_fn serving THAT artifact with
+    # this fleet's serve flags (fleetctl rollout warms the new version
+    # through it, then repoints standby respawns)
+    def _spawn_template(model_dir):
+        args = [a for a in child_args
+                if not a.startswith(("--model_dir=", "--model="))]
+        args.append(f"--model_dir={model_dir}")
+        return replica_spawner(args)
+
+    fleet.spawn_template = _spawn_template
     print(f"spawning {n} replica(s)"
           + (f" + {standby} warm standby" if standby else "")
           + " ...", flush=True)
     fleet.start()
     for r in router.replicas():
         print(f"  replica {r.name}: {r.url}", flush=True)
+    scaler = None
+    if opts.get("autoscale", "0") not in ("0", "false", "no", ""):
+        from .fleetctl import Autoscaler, AutoscalerConfig
+
+        cfg = AutoscalerConfig(
+            min_replicas=int(opts.get("min_replicas", 1)),
+            max_replicas=int(opts.get("max_replicas", max(n, 1) + max(
+                standby, 1))),
+            cooldown_s=float(opts.get("cooldown_s", 3.0)))
+        scaler = Autoscaler(fleet, cfg).start()
+        print(f"autoscaler armed: {cfg.min_replicas}.."
+              f"{cfg.max_replicas} replicas, "
+              f"cooldown {cfg.cooldown_s:g}s", flush=True)
     server = make_router_server(
         router, host=opts.get("host", "127.0.0.1"),
-        port=int(opts.get("port", 8866)))
+        port=int(opts.get("port", 8866)),
+        fleet=fleet, autoscaler=scaler)
     server.serve_background()
 
     import signal
@@ -572,9 +638,68 @@ def _serve_fleet(opts) -> int:
         pass
     print("stopping fleet (graceful: replicas drain in-flight work)",
           flush=True)
+    if scaler is not None:
+        scaler.stop()
     server.shutdown()
     fleet.stop(graceful=True)
     server.server_close()
+    return 0
+
+
+def _cmd_fleetctl(argv) -> int:
+    """Control-plane client for a running fleet router: `rollout`
+    POSTs /admin/rollout (zero-downtime version flip), `status` GETs
+    /admin/fleet (router health + fleet + autoscaler in one doc)."""
+    import json as _json
+    import urllib.error
+    import urllib.request
+
+    if not argv or argv[0] in ("-h", "--help"):
+        raise SystemExit(
+            "usage: fleetctl rollout --router URL --model_dir D "
+            "[--model NAME]\n       fleetctl status --router URL")
+    verb, rest = argv[0], argv[1:]
+    known = {"router": str, "model_dir": str, "model": str,
+             "drain_timeout_s": str}
+    opts = _parse_kv(rest, known)
+    url = (opts.get("router") or "http://127.0.0.1:8866").rstrip("/")
+    try:
+        if verb == "status":
+            with urllib.request.urlopen(url + "/admin/fleet",
+                                        timeout=10.0) as f:
+                payload = _json.load(f)
+        elif verb == "rollout":
+            if not opts.get("model_dir"):
+                raise SystemExit("fleetctl rollout requires "
+                                 "--model_dir <new artifact dir>")
+            body = {"model_dir": opts["model_dir"],
+                    "model": opts.get("model", "default")}
+            if opts.get("drain_timeout_s"):
+                body["drain_timeout_s"] = float(opts["drain_timeout_s"])
+            req = urllib.request.Request(
+                url + "/admin/rollout",
+                data=_json.dumps(body).encode(),
+                headers={"Content-Type": "application/json"})
+            # rollout blocks through warm+verify+flip+drain; size the
+            # client timeout for a model load, not a ping
+            with urllib.request.urlopen(req, timeout=600.0) as f:
+                payload = _json.load(f)
+        else:
+            raise SystemExit(
+                f"unknown fleetctl verb {verb!r}; try: rollout, status")
+    except urllib.error.HTTPError as e:
+        try:
+            detail = _json.load(e).get("error", "")
+        except Exception:
+            detail = ""
+        print(f"fleetctl {verb} failed: HTTP {e.code} {detail}",
+              file=sys.stderr)
+        return 1
+    except urllib.error.URLError as e:
+        print(f"cannot reach router at {url}: {e.reason}",
+              file=sys.stderr)
+        return 1
+    print(_json.dumps(payload, indent=2, sort_keys=True))
     return 0
 
 
@@ -1010,6 +1135,8 @@ def main(argv=None) -> int:
         return _cmd_serve(rest)
     if cmd == "route":
         return _cmd_route(rest)
+    if cmd == "fleetctl":
+        return _cmd_fleetctl(rest)
     if cmd == "tune":
         return _cmd_tune(rest)
     if cmd == "quant":
@@ -1025,7 +1152,8 @@ def main(argv=None) -> int:
         print(full_version)
         return 0
     raise SystemExit(f"unknown command {cmd!r}; try: train, merge_model, "
-                     "serve, route, tune, quant, stats, flags, version")
+                     "serve, route, fleetctl, tune, quant, stats, flags, "
+                     "version")
 
 
 if __name__ == "__main__":

@@ -381,6 +381,11 @@ def save_inference_model(
                 "fetch_names": target_names,
                 "param_names": param_names,
                 "feed_specs": feed_specs,
+                # the artifact's identity for fleet rollout: a replica
+                # reports this hash on /healthz so a rollout can verify
+                # every standby actually loaded the new version before
+                # the router flips (fleetctl/rollout.py)
+                "program_fingerprint": program_fingerprint(pruned),
                 "tuning": tuning,
                 **({"generation": generation} if generation else {}),
                 **({"sharding": sharding} if sharding else {}),
@@ -489,6 +494,10 @@ def load_inference_model(dirname: str, scope: Optional[Scope] = None):
     # serving sidecar (absent in pre-serving artifacts): per-feed
     # dtype/shape specs, consumed by serving.ServingEngine
     program._serving_meta = meta.get("feed_specs") or None
+    # artifact identity (absent in pre-fleet artifacts): the exporter's
+    # program fingerprint; ServingEngine recomputes it when missing so
+    # /healthz "versions" is populated for every artifact age
+    program._program_fingerprint = meta.get("program_fingerprint") or None
     # tuned-kernel provenance (absent in pre-tuner artifacts): the
     # exporter's device_kind + tuned-table fingerprint, checked by
     # serving.ServingEngine.warmup against the serving host's table

@@ -230,6 +230,28 @@ class MetricsRegistry:
             if fn in self._collectors:
                 self._collectors.remove(fn)
 
+    def remove_series(self, name: str,
+                      labels: Optional[Dict[str, Any]] = None) -> bool:
+        """Drop ONE labeled series from a counter family (the family
+        stays as long as any series remains). This is the retirement
+        half of the per-entity counter lifecycle: a replica that is
+        deliberately scaled down or rolled away takes its
+        `{replica="..."}` series with it, so a long-lived router's
+        scrape surface tracks the live fleet instead of accreting dead
+        series forever. Counters for FAILED replicas are kept by their
+        owners (failure history is evidence; see Router.remove_replica).
+        Returns True when the series existed."""
+        key = _label_key(labels)
+        with self._lock:
+            fam = self._counters.get(name)
+            if fam is None or key not in fam:
+                return False
+            del fam[key]
+            if not fam:
+                del self._counters[name]
+                self._help.pop(name, None)
+            return True
+
     def reset_metrics(self) -> None:
         """Drop all registered series (test isolation via pt.reset());
         collectors stay — they read external module state that owns its

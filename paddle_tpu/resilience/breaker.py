@@ -90,6 +90,20 @@ class CircuitBreaker:
                 return True
             return False
 
+    def would_admit(self) -> bool:
+        """admit() without consuming a HALF_OPEN probe slot: for
+        CANDIDATE scans (the router's per-class JSQ pick walks every
+        replica) where only the winner actually dispatches. A scan
+        that burned the probe budget of a half-open loser would leave
+        its breaker refusing traffic with no probe ever sent — the
+        outcome-recording caller must still pair the real dispatch
+        with admit()."""
+        with self._lock:
+            s = self._state_locked()
+            if s == CLOSED:
+                return True
+            return s == HALF_OPEN and self._probes < self.half_open_max
+
     # -- outcomes (one coalesced engine call = one outcome) -------------
     def record_success(self) -> None:
         with self._lock:

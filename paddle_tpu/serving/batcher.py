@@ -45,6 +45,7 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
+from ..fleetctl.tenancy import BATCH, INTERACTIVE, SLO_CLASSES
 from ..obs import trace as obs_trace
 from ..resilience.breaker import CircuitBreaker, CircuitOpenError
 from .engine import ServingEngine
@@ -52,6 +53,27 @@ from .metrics import MetricSet
 
 __all__ = ["MicroBatcher", "AdmissionQueue", "ShedError", "DeadlineError",
            "CircuitOpenError"]
+
+
+def _declare_slo_counters(metrics: MetricSet) -> None:
+    """Fleet-wide per-class admission accounting: ONE pt_-prefixed
+    family pair on the unified registry (not per-model namespaced), so
+    an autoscaler or an operator reads 'is the batch tier absorbing
+    the pressure?' from a single pair of labeled series."""
+    for cls in SLO_CLASSES:
+        metrics.registry.declare_counter(
+            "pt_slo_admitted_total",
+            help="requests admitted to a serving queue, by SLO class",
+            labels={"slo": cls})
+        metrics.registry.declare_counter(
+            "pt_slo_shed_total",
+            help="requests shed (queue pressure), by SLO class — the "
+                 "shed order is strictly batch-first",
+            labels={"slo": cls})
+
+
+def _slo_count(metrics: MetricSet, name: str, cls: str) -> None:
+    metrics.registry.counter_inc(name, labels={"slo": cls})
 
 
 class ShedError(RuntimeError):
@@ -63,25 +85,38 @@ class DeadlineError(RuntimeError):
 
 
 class AdmissionQueue:
-    """Bounded, deadline-aware FIFO — the admission half of the
-    MicroBatcher contract factored out so the generation path's
-    token-level scheduler shares the SAME shed/deadline semantics:
+    """Bounded, deadline-aware, TWO-LEVEL priority FIFO — the admission
+    half of the MicroBatcher contract factored out so the generation
+    path's token-level scheduler shares the SAME shed/deadline
+    semantics, now tiered by SLO class (fleetctl.tenancy):
 
-    - `put()` rejects immediately with ShedError when `max_queue`
-      requests are waiting (503 + Retry-After, never an unbounded
-      backlog), counting `<prefix>shed_total`.
-    - `pop()` hands back the oldest request; requests found expired
-      are failed with DeadlineError (504) via their `fail()` and
-      counted as `<prefix>deadline_exceeded_total` — and, exactly like
-      MicroBatcher's post-engine re-check, the consumer is expected to
-      RE-CHECK `deadline` after slot admission / dispatch so a request
-      never receives a late first token its client already gave up on
-      (`expire()` is that re-check's failure path).
+    - one FIFO per class (`interactive`, `batch`); `pop()` serves the
+      interactive tier to exhaustion before touching batch, each tier
+      oldest-first.
+    - `put()` admits while total depth < `max_queue`. At capacity the
+      shed order is STRICTLY batch-first: an arriving interactive
+      request displaces the NEWEST queued batch request (which fails
+      with a retryable ShedError) — an interactive request is shed
+      only when the entire queue is already interactive; an arriving
+      batch request at capacity is shed immediately. Invariant (pinned
+      by a property test): no interactive request is ever shed while
+      any batch request occupies the queue.
+    - `pop()` hands back the oldest request of the best class;
+      requests found expired are failed with DeadlineError (504) via
+      their `fail()` and counted as `<prefix>deadline_exceeded_total`
+      — and, exactly like MicroBatcher's post-engine re-check, the
+      consumer is expected to RE-CHECK `deadline` after slot
+      admission / dispatch so a request never receives a late first
+      token its client already gave up on (`expire()` is that
+      re-check's failure path).
 
     Items need two attributes: `deadline` (monotonic seconds) and
-    `fail(exc)` (terminal failure delivery). The caller supplies the
-    Condition so one lock can cover queue state plus whatever else the
-    consumer's worker loop sleeps on (e.g. decode-slot occupancy)."""
+    `fail(exc)` (terminal failure delivery); an optional `slo_class`
+    ("interactive" when absent) selects the tier, and `enqueued_at` is
+    stamped at admission so /healthz can report the age of the oldest
+    queued request. The caller supplies the Condition so one lock can
+    cover queue state plus whatever else the consumer's worker loop
+    sleeps on (e.g. decode-slot occupancy)."""
 
     def __init__(self, max_queue: int, cond: threading.Condition,
                  metrics: MetricSet, prefix: str = ""):
@@ -89,7 +124,8 @@ class AdmissionQueue:
         self.cond = cond
         self.metrics = metrics
         self.prefix = prefix
-        self._q: collections.deque = collections.deque()
+        self._tiers: Dict[str, collections.deque] = {
+            cls: collections.deque() for cls in SLO_CLASSES}
         # pre-registered so scrapers see the series at 0, not appearing
         # on the first shed/expiry
         metrics.declare_counter(
@@ -98,35 +134,83 @@ class AdmissionQueue:
         metrics.declare_counter(
             f"{prefix}deadline_exceeded_total",
             help="requests that expired before their result")
+        _declare_slo_counters(metrics)
 
     def __len__(self) -> int:
         with self.cond:
-            return len(self._q)
+            return sum(len(q) for q in self._tiers.values())
 
     def depth(self) -> int:
-        return len(self._q)  # advisory (gauges); exact depth needs cond
+        # advisory (gauges); exact depth needs the cond
+        return sum(len(q) for q in self._tiers.values())
+
+    def depth_by_class(self) -> Dict[str, int]:
+        """Advisory per-tier depths (/healthz classes block)."""
+        return {cls: len(q) for cls, q in self._tiers.items()}
+
+    def oldest_enqueued(self) -> Optional[float]:
+        """Monotonic enqueue time of the oldest queued request across
+        tiers, or None when empty. Advisory (tier heads are each
+        tier's oldest — FIFO within a tier)."""
+        heads = []
+        for q in self._tiers.values():
+            try:
+                heads.append(q[0].enqueued_at)
+            except IndexError:
+                pass
+        return min(heads) if heads else None
+
+    def _shed(self, req, cls: str, msg: str) -> None:
+        """Count + fail one request as shed. Caller holds the cond."""
+        self.metrics.counter_inc(
+            f"{self.prefix}shed_total",
+            help="requests rejected because the queue was full")
+        _slo_count(self.metrics, "pt_slo_shed_total", cls)
+        req.fail(ShedError(msg))
 
     def put(self, req) -> None:
-        """Enqueue or shed. Caller must NOT hold the condition."""
+        """Enqueue or shed (batch-first at capacity). Caller must NOT
+        hold the condition. Raises ShedError when REQ itself is shed;
+        a displaced batch request fails through its own `fail()`."""
+        cls = getattr(req, "slo_class", None) or INTERACTIVE
         with self.cond:
-            if len(self._q) >= self.max_queue:
-                self.metrics.counter_inc(
-                    f"{self.prefix}shed_total",
-                    help="requests rejected because the queue was full")
-                raise ShedError(
-                    f"queue full ({self.max_queue} waiting); retry later")
-            self._q.append(req)
+            total = sum(len(q) for q in self._tiers.values())
+            if total >= self.max_queue:
+                batch_q = self._tiers[BATCH]
+                if cls == BATCH or not batch_q:
+                    # arriving batch, or a queue already pure
+                    # interactive: the arrival itself is shed
+                    self.metrics.counter_inc(
+                        f"{self.prefix}shed_total",
+                        help="requests rejected because the queue "
+                             "was full")
+                    _slo_count(self.metrics, "pt_slo_shed_total", cls)
+                    raise ShedError(
+                        f"queue full ({self.max_queue} waiting); "
+                        "retry later")
+                # interactive arrival displaces the NEWEST batch
+                # request — the batch tier absorbs the pressure so
+                # interactive never queues behind a full house
+                self._shed(batch_q.pop(), BATCH,
+                           "displaced by interactive admission; "
+                           "retry later")
+            req.enqueued_at = time.monotonic()
+            self._tiers[cls].append(req)
+            _slo_count(self.metrics, "pt_slo_admitted_total", cls)
             self.cond.notify_all()
 
     def pop(self):
-        """Oldest non-expired request, or None. Expired requests are
-        failed (DeadlineError) and skipped. Caller holds the cond."""
-        while self._q:
-            req = self._q.popleft()
-            if req.deadline <= time.monotonic():
-                self.expire(req, "deadline exceeded while queued")
-                continue
-            return req
+        """Oldest non-expired request of the highest-priority
+        non-empty tier, or None. Expired requests are failed
+        (DeadlineError) and skipped. Caller holds the cond."""
+        for cls in SLO_CLASSES:
+            q = self._tiers[cls]
+            while q:
+                req = q.popleft()
+                if req.deadline <= time.monotonic():
+                    self.expire(req, "deadline exceeded while queued")
+                    continue
+                return req
         return None
 
     def expire(self, req, msg: str) -> None:
@@ -140,17 +224,21 @@ class AdmissionQueue:
     def drain(self, exc: Exception) -> None:
         """Fail everything still queued (shutdown/abort)."""
         with self.cond:
-            while self._q:
-                self._q.popleft().fail(exc)
+            for q in self._tiers.values():
+                while q:
+                    q.popleft().fail(exc)
 
 
 class _Request:
     __slots__ = ("feed", "rows", "future", "deadline", "signature",
-                 "request_id")
+                 "request_id", "slo_class", "enqueued_at")
 
     def __init__(self, feed: Dict[str, np.ndarray], deadline: float,
-                 request_id: Optional[str] = None):
+                 request_id: Optional[str] = None,
+                 slo_class: str = INTERACTIVE):
         self.feed = feed
+        self.slo_class = slo_class
+        self.enqueued_at = 0.0  # stamped at admission
         # a router-minted id (X-PT-Request-Id) is adopted so one trace
         # shows router pick → replica queue → engine call for a request;
         # locally-submitted requests mint their own
@@ -211,6 +299,7 @@ class MicroBatcher:
             "circuit_open_total",
             help="requests rejected because the model's circuit breaker "
                  "was open")
+        _declare_slo_counters(self.metrics)
 
     # -- lifecycle ------------------------------------------------------
     def start(self) -> "MicroBatcher":
@@ -244,12 +333,24 @@ class MicroBatcher:
     # -- client side ----------------------------------------------------
     def submit(self, feed: Dict[str, np.ndarray],
                timeout_ms: Optional[float] = None,
-               request_id: Optional[str] = None) -> Future:
+               request_id: Optional[str] = None,
+               slo: Optional[str] = None) -> Future:
+        """Enqueue one request. `slo` tiers it ("interactive" default):
+        the queue keeps interactive requests ahead of batch, and at
+        capacity the shed order is strictly batch-first — an arriving
+        interactive request displaces the newest queued batch request
+        (failed with ShedError through its future) and is never itself
+        shed while any batch request occupies the queue."""
+        cls = slo or INTERACTIVE
+        if cls not in SLO_CLASSES:
+            raise ValueError(
+                f"unknown SLO class {cls!r}; expected one of "
+                f"{SLO_CLASSES}")
         req = _Request(
             feed,
             time.monotonic() + (timeout_ms / 1e3 if timeout_ms is not None
                                 else self.timeout_s),
-            request_id=request_id)
+            request_id=request_id, slo_class=cls)
         if req.rows > self.max_batch_size:
             raise ValueError(
                 f"request rows {req.rows} exceed max_batch_size "
@@ -266,18 +367,67 @@ class MicroBatcher:
             if self._stopping:
                 raise ShedError("batcher stopped")
             if len(self._q) >= self.max_queue:
+                victim = None
+                if cls == INTERACTIVE:
+                    # newest queued batch request, scanning from the
+                    # tail (the deque is interactive-first, so batch
+                    # work sits at the back)
+                    for i in range(len(self._q) - 1, -1, -1):
+                        if self._q[i].slo_class == BATCH:
+                            victim = self._q[i]
+                            del self._q[i]
+                            break
+                if victim is None:
+                    self.metrics.counter_inc(
+                        "shed_total",
+                        help="requests rejected because the queue "
+                             "was full")
+                    _slo_count(self.metrics, "pt_slo_shed_total", cls)
+                    raise ShedError(
+                        f"queue full ({self.max_queue} waiting); "
+                        "retry later")
                 self.metrics.counter_inc(
                     "shed_total",
                     help="requests rejected because the queue was full")
-                raise ShedError(
-                    f"queue full ({self.max_queue} waiting); retry later")
-            self._q.append(req)
+                _slo_count(self.metrics, "pt_slo_shed_total", BATCH)
+                victim.future.set_exception(ShedError(
+                    "displaced by interactive admission; retry later"))
+            req.enqueued_at = time.monotonic()
+            if cls == BATCH:
+                self._q.append(req)
+            else:
+                # insert ahead of the first batch request so dispatch
+                # order within the window is interactive-first
+                at = len(self._q)
+                for i, other in enumerate(self._q):
+                    if other.slo_class == BATCH:
+                        at = i
+                        break
+                self._q.insert(at, req)
+            _slo_count(self.metrics, "pt_slo_admitted_total", cls)
             self._cond.notify()
         return req.future
 
+    def oldest_enqueued(self) -> Optional[float]:
+        """Monotonic enqueue time of the oldest queued request, or
+        None when empty (/healthz queue_age_ms)."""
+        with self._cond:
+            if not self._q:
+                return None
+            return min(r.enqueued_at for r in self._q)
+
+    def depth_by_class(self) -> Dict[str, int]:
+        """Queue depth per SLO class (/healthz classes block)."""
+        with self._cond:
+            out = {c: 0 for c in SLO_CLASSES}
+            for r in self._q:
+                out[r.slo_class] += 1
+            return out
+
     def predict(self, feed: Dict[str, np.ndarray],
                 timeout_ms: Optional[float] = None,
-                request_id: Optional[str] = None) -> List[np.ndarray]:
+                request_id: Optional[str] = None,
+                slo: Optional[str] = None) -> List[np.ndarray]:
         """submit + wait. Raises ShedError / DeadlineError / the
         engine's exception. The wait allows the deadline plus an equal
         grace (min 1 s) for a dispatch already in flight — a cold
@@ -285,7 +435,7 @@ class MicroBatcher:
         alone; warm the engine (ServingEngine.warmup) to avoid
         first-request 504s."""
         fut = self.submit(feed, timeout_ms=timeout_ms,
-                          request_id=request_id)
+                          request_id=request_id, slo=slo)
         budget = (timeout_ms / 1e3 if timeout_ms is not None
                   else self.timeout_s)
         try:

@@ -155,6 +155,15 @@ class ServingEngine:
         # cost. A quantized artifact also serves fine WITHOUT the knob:
         # it is just a program + params; the knob is the operator's
         # declared intent, so a misrouted fp artifact fails here.
+        # artifact identity: the exporter's program fingerprint
+        # (meta.json since the fleet-control PR); recomputed for older
+        # artifacts so /healthz "versions" always has a value — this is
+        # what a zero-downtime rollout verifies before flipping traffic
+        from ..io import program_fingerprint as _pfp
+
+        self.fingerprint = (
+            getattr(self.program, "_program_fingerprint", None)
+            or _pfp(self.program))
         self.quant_meta = getattr(self.program, "_quant_meta", None)
         self.quantize = quantize
         if quantize is not None:

@@ -71,6 +71,7 @@ import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
+from ..fleetctl.tenancy import SLO_HEADER, SLOPolicy, resolve_class
 from ..obs import metrics as obs_metrics
 from ..obs import trace as obs_trace
 from ..resilience.breaker import STATE_CODES, CircuitBreaker
@@ -114,29 +115,46 @@ class ReplicaClient:
         self.process = process
         self.inflight = 0          # router-local dispatched-not-done
         self.up = False            # last probe outcome
+        self.draining = False      # retiring (rollout/scale-down):
+        #                            finishes in-flight work, never
+        #                            picked for new requests
         self.snapshot: Dict[str, Any] = {}  # /healthz "load" block
+        self.versions: Dict[str, str] = {}  # /healthz model→fingerprint
         self.last_probe_s = 0.0
         self.last_picked = 0       # pick-sequence tie-break (JSQ ties
         #                            round-robin instead of pile-on)
 
-    def score(self) -> float:
+    def score(self, slo: Optional[str] = None) -> float:
         """Join-shortest-queue load score: router-tracked in-flight
         (fresh, covers the probe staleness window) plus the replica's
-        last-reported queue depth and active slots. Lower = less
-        loaded. Pure reads — no I/O, no locks."""
+        last-reported queue depth and active slots. With `slo` given
+        and a per-class breakdown in the snapshot, the CLASS's own
+        queue depth is scored instead of the total — a replica whose
+        backlog is all batch work still looks short to interactive
+        traffic (the batch tier sheds for it on admission). Lower =
+        less loaded. Pure reads — no I/O, no locks."""
         snap = self.snapshot
+        depth: Optional[float] = None
+        if slo is not None:
+            classes = snap.get("classes")
+            if isinstance(classes, dict) and slo in classes:
+                depth = float(classes[slo])
+        if depth is None:
+            depth = float(snap.get("queue_depth", 0))
         return (2.0 * self.inflight
-                + float(snap.get("queue_depth", 0))
+                + depth
                 + float(snap.get("active_slots", 0)))
 
     def describe(self) -> Dict[str, Any]:
         return {
             "url": self.url,
             "up": self.up,
+            "draining": self.draining,
             "breaker": self.breaker.state(),
             "inflight": self.inflight,
             "score": self.score(),
             "load": dict(self.snapshot),
+            "versions": dict(self.versions),
         }
 
 
@@ -182,10 +200,16 @@ class Router:
         request_timeout_s: float = 120.0,
         breaker_kw: Optional[dict] = None,
         registry: Optional[obs_metrics.MetricsRegistry] = None,
+        slo_policy: Optional[SLOPolicy] = None,
     ):
         self.probe_interval_s = probe_interval_s
         self.probe_timeout_s = probe_timeout_s
         self.request_timeout_s = request_timeout_s
+        # per-model SLO classes (fleetctl.tenancy): the router resolves
+        # a request's class ONCE (model default, demotable by header/
+        # body), scores the pick with it, and forwards it so the
+        # replica's admission tiers agree with the pick
+        self.slo_policy = slo_policy or SLOPolicy()
         self._breaker_kw = dict(breaker_kw or {})
         self._lock = threading.Lock()
         self._replicas: "collections.OrderedDict[str, ReplicaClient]" = (
@@ -215,20 +239,22 @@ class Router:
             self.add_replica(url)
 
     # -- fleet membership ----------------------------------------------
-    def add_replica(self, url: str, name: Optional[str] = None,
-                    process: Optional["ReplicaProcess"] = None,
-                    breaker: Optional[CircuitBreaker] = None
-                    ) -> ReplicaClient:
-        with self._lock:
-            if name is None:
-                name = f"r{self._next_name}"
-            self._next_name += 1
-            if name in self._replicas:
-                raise ValueError(f"replica {name!r} already registered")
-            if breaker is None and self._breaker_kw:
-                breaker = CircuitBreaker(**self._breaker_kw)
-            r = ReplicaClient(name, url, process=process, breaker=breaker)
-            self._replicas[name] = r
+    def _add_locked(self, url: str, name: Optional[str],
+                    process: Optional["ReplicaProcess"],
+                    breaker: Optional[CircuitBreaker]) -> ReplicaClient:
+        """Create + register one client. Caller holds self._lock."""
+        if name is None:
+            name = f"r{self._next_name}"
+        self._next_name += 1
+        if name in self._replicas:
+            raise ValueError(f"replica {name!r} already registered")
+        if breaker is None and self._breaker_kw:
+            breaker = CircuitBreaker(**self._breaker_kw)
+        r = ReplicaClient(name, url, process=process, breaker=breaker)
+        self._replicas[name] = r
+        return r
+
+    def _declare_replica_counters(self, name: str) -> None:
         # per-replica counters declare at registration so the scrape
         # surface is complete before the first request routes
         for cname, chelp in (
@@ -240,40 +266,104 @@ class Router:
         ):
             self.registry.declare_counter(cname, help=chelp,
                                           labels={"replica": name})
+
+    def add_replica(self, url: str, name: Optional[str] = None,
+                    process: Optional["ReplicaProcess"] = None,
+                    breaker: Optional[CircuitBreaker] = None
+                    ) -> ReplicaClient:
+        with self._lock:
+            r = self._add_locked(url, name, process, breaker)
+        self._declare_replica_counters(r.name)
         self._probe_now()
         return r
 
-    def remove_replica(self, name: str) -> Optional[ReplicaClient]:
+    def remove_replica(self, name: str,
+                       retire_series: bool = False
+                       ) -> Optional[ReplicaClient]:
+        """Drop one replica from the rotation. `retire_series=True` —
+        the DELIBERATE retirement path (scale-down, rollout drain) —
+        also removes the replica's labeled counter series from the
+        registry so a scaled-down fleet doesn't accumulate dead
+        `pt_router_*{replica=}` series (the `pt_replica_*` gauges are
+        collector-rendered from live membership, so they drop with the
+        client). FAILURE removal keeps the series: a SIGKILLed
+        replica's routed/failed-over history is evidence (test_fleet
+        pins this)."""
         with self._lock:
-            return self._replicas.pop(name, None)
+            r = self._replicas.pop(name, None)
+        if r is not None and retire_series:
+            for cname in ("pt_router_routed_total",
+                          "pt_router_failed_over_total"):
+                self.registry.remove_series(cname,
+                                            labels={"replica": name})
+        return r
+
+    def set_draining(self, name: str, draining: bool = True) -> bool:
+        """Mark a replica as retiring: it finishes what it has but
+        pick() never selects it again. Returns False for unknown
+        names."""
+        with self._lock:
+            r = self._replicas.get(name)
+            if r is None:
+                return False
+            r.draining = draining
+            return True
+
+    def flip(self, add: Sequence[Tuple[str, Optional["ReplicaProcess"]]],
+             drain: Sequence[str]) -> List[ReplicaClient]:
+        """ATOMIC membership change — the rollout's cutover point:
+        under one lock acquisition the new replicas join the rotation
+        and the old ones are marked draining, so there is no instant
+        where a request can find neither version (zero-downtime
+        contract; fleetctl/rollout.py drains + removes the old ones
+        afterwards). Returns the clients added."""
+        added: List[ReplicaClient] = []
+        with self._lock:
+            for name in drain:
+                r = self._replicas.get(name)
+                if r is not None:
+                    r.draining = True
+            for url, process in add:
+                added.append(self._add_locked(url, None, process, None))
+        for r in added:
+            self._declare_replica_counters(r.name)
+        self._probe_now()
+        return added
 
     def replicas(self) -> List[ReplicaClient]:
         with self._lock:
             return list(self._replicas.values())
 
     # -- the pick hot path (NO blocking I/O — AST-linted) ---------------
-    def pick(self, exclude: Sequence[str] = ()) -> Optional[ReplicaClient]:
+    def pick(self, exclude: Sequence[str] = (),
+             slo: Optional[str] = None) -> Optional[ReplicaClient]:
         """Join-shortest-queue over admitted replicas: lowest score()
         wins, ties go to the least-recently-picked (round-robin under
-        uniform load instead of herding onto one replica). Reads ONLY
-        router-local state — breaker admission, in-flight counters and
-        the probe loop's cached snapshots; never the network."""
-        best: Optional[ReplicaClient] = None
-        best_key: Tuple[float, int] = (float("inf"), 0)
+        uniform load instead of herding onto one replica). With `slo`
+        given, replicas are scored by that class's own queue depth
+        (per-class JSQ — batch backlog doesn't repel interactive
+        traffic). Draining replicas (rollout/scale-down) are never
+        picked. Reads ONLY router-local state — breaker admission,
+        in-flight counters and the probe loop's cached snapshots;
+        never the network."""
         with self._lock:
-            for r in self._replicas.values():
-                if r.name in exclude:
-                    continue
-                if not r.breaker.admit():
-                    continue
-                key = (r.score(), r.last_picked)
-                if best is None or key < best_key:
-                    best, best_key = r, key
-            if best is not None:
+            # scan with would_admit() (non-consuming) so a HALF_OPEN
+            # replica that loses the JSQ comparison keeps its probe
+            # budget; only the winner pays admit()
+            ranked = sorted(
+                ((r.score(slo), r.last_picked, r)
+                 for r in self._replicas.values()
+                 if r.name not in exclude and not r.draining
+                 and r.breaker.would_admit()),
+                key=lambda t: t[:2])
+            for _, _, best in ranked:
+                if not best.breaker.admit():
+                    continue  # raced OPEN since the scan; next-best
                 self._seq += 1
                 best.last_picked = self._seq
                 best.inflight += 1
-        return best
+                return best
+        return None
 
     def _release(self, replica: ReplicaClient) -> None:
         with self._lock:
@@ -282,10 +372,15 @@ class Router:
     # -- dispatch -------------------------------------------------------
     def dispatch(self, path: str, body: bytes,
                  request_id: Optional[str] = None,
-                 headers: Optional[Dict[str, str]] = None) -> _Lease:
+                 headers: Optional[Dict[str, str]] = None,
+                 slo: Optional[str] = None) -> _Lease:
         """POST `body` to the best replica; returns a _Lease whose
         response is either buffered (`lease.body`) or streaming
         (`lease.resp` — chunked NDJSON, relay then `close()`).
+
+        `slo` drives the per-class pick and is forwarded in
+        X-PT-SLO-Class so the replica's admission tiers agree with the
+        score the pick used.
 
         Failover contract: a 503 (replica shed / its model breaker)
         and any transport error move on to the next-best replica the
@@ -293,10 +388,13 @@ class Router:
         the replica's ROUTER-side breaker. Raises NoReplicaError when
         no admittable replica remains."""
         self.registry.counter_inc("pt_router_requests_total")
+        if slo is not None:
+            headers = dict(headers or {})
+            headers[SLO_HEADER] = slo
         tried: List[str] = []
         last_shed: Optional[_Lease] = None
         while True:
-            replica = self.pick(exclude=tried)
+            replica = self.pick(exclude=tried, slo=slo)
             if replica is None:
                 if last_shed is not None:
                     # every admitted replica shed: relay the final 503
@@ -427,6 +525,7 @@ class Router:
             return False
         replica.up = payload.get("status") in ("ok", "degraded")
         replica.snapshot = payload.get("load") or {}
+        replica.versions = payload.get("versions") or {}
         replica.last_probe_s = time.monotonic()
         if replica.up and replica.breaker.state() != "closed":
             # the half-open probe budget is spent on a HEALTH CHECK,
@@ -473,7 +572,8 @@ class Router:
         reps = self.replicas()
         if not reps:
             return []
-        up, state, queue, slots, inflight = [], [], [], [], []
+        up, state, queue, slots, inflight, draining = ([], [], [], [],
+                                                       [], [])
         for r in reps:
             lb = {"replica": r.name}
             up.append((lb, 1.0 if r.up else 0.0))
@@ -481,6 +581,7 @@ class Router:
             queue.append((lb, float(r.snapshot.get("queue_depth", 0))))
             slots.append((lb, float(r.snapshot.get("active_slots", 0))))
             inflight.append((lb, float(r.inflight)))
+            draining.append((lb, 1.0 if r.draining else 0.0))
         return [
             ("pt_replica_up", "gauge",
              "1 while the replica's last health probe succeeded", up),
@@ -495,6 +596,9 @@ class Router:
             ("pt_replica_inflight", "gauge",
              "router-tracked requests in flight on the replica",
              inflight),
+            ("pt_replica_draining", "gauge",
+             "1 while the replica is retiring (rollout/scale-down): "
+             "finishing in-flight work, excluded from picks", draining),
         ]
 
 
@@ -535,10 +639,15 @@ class _RouterHandler(BaseHTTPRequestHandler):
         elif self.path == "/metrics":
             self._send(200, router.registry.render().encode(),
                        content_type="text/plain; version=0.0.4")
+        elif self.path == "/admin/fleet":
+            self._send(200, self.server.admin_fleet())
         else:
             self._error(404, f"no route {self.path!r}")
 
     def do_POST(self):
+        if self.path == "/admin/rollout":
+            self._admin_rollout()
+            return
         if not (self.path.startswith("/predict")
                 or self.path.startswith("/generate")):
             self._error(404, f"no route {self.path!r}")
@@ -551,9 +660,16 @@ class _RouterHandler(BaseHTTPRequestHandler):
         rid = (self.headers.get(REQUEST_ID_HEADER)
                or obs_trace.new_request_id("rt"))
         try:
+            slo = self._resolve_slo(body)
+        except ValueError as e:
+            self._error(400, f"bad request: {e}")
+            return
+        try:
             with obs_trace.span("http.route", cat="router",
-                                path=self.path, request_id=rid):
-                lease = router.dispatch(self.path, body, request_id=rid)
+                                path=self.path, request_id=rid,
+                                slo=slo):
+                lease = router.dispatch(self.path, body, request_id=rid,
+                                        slo=slo)
         except NoReplicaError as e:
             self._error(503, str(e))
             return
@@ -575,6 +691,55 @@ class _RouterHandler(BaseHTTPRequestHandler):
             pass  # client went away; replica finishes server-side
         finally:
             lease.close()
+
+    def _resolve_slo(self, body: bytes) -> str:
+        """The request's SLO class, resolved ONCE at the router: the
+        model's class (from the path) is the default; the request may
+        demote itself via X-PT-SLO-Class or the body "slo" field.
+        Raises ValueError on an unknown class name (400)."""
+        model = "default"
+        for route in ("/predict/", "/generate/"):
+            if self.path.startswith(route):
+                model = self.path[len(route):]
+                break
+        requested = self.headers.get(SLO_HEADER)
+        if not requested and b'"slo"' in body:
+            try:
+                requested = json.loads(body).get("slo")
+            except (ValueError, AttributeError):
+                requested = None
+        return resolve_class(
+            self.server.router.slo_policy.class_of(model), requested)
+
+    def _admin_rollout(self) -> None:
+        """POST /admin/rollout {"model_dir": ..., "model": opt}: run a
+        zero-downtime rollout of the artifact at model_dir through the
+        attached fleet (cli `paddle_tpu fleetctl rollout` calls this).
+        Blocking — the reply is the rollout report."""
+        fleet = self.server.fleet
+        if fleet is None:
+            self._error(501, "no fleet attached to this router "
+                             "(serve --replicas builds one)")
+            return
+        try:
+            length = int(self.headers.get("Content-Length", 0))
+            req = json.loads(self.rfile.read(length) or b"{}")
+            model_dir = req["model_dir"]
+        except (ValueError, KeyError, TypeError) as e:
+            self._error(400, f"bad request: {e}")
+            return
+        from ..fleetctl.rollout import RolloutError, RolloutManager
+
+        try:
+            report = RolloutManager(fleet).rollout(
+                model_dir, model=req.get("model", "default"))
+        except RolloutError as e:
+            self._error(409, str(e))
+            return
+        except Exception as e:
+            self._error(500, f"{type(e).__name__}: {e}")
+            return
+        self._send(200, report)
 
     def _relay_stream(self, lease: _Lease, rid: str) -> None:
         """Chunked NDJSON pass-through, one line per chunk. A replica
@@ -623,9 +788,26 @@ class _RouterHandler(BaseHTTPRequestHandler):
 class RouterServer(ThreadingHTTPServer):
     daemon_threads = True
 
-    def __init__(self, addr, router: Router):
+    def __init__(self, addr, router: Router,
+                 fleet: Optional["Fleet"] = None,
+                 autoscaler=None):
         super().__init__(addr, _RouterHandler)
         self.router = router
+        # control-plane attachments (cli _serve_fleet wires these): the
+        # fleet enables /admin/rollout; the autoscaler reports through
+        # /admin/fleet
+        self.fleet = fleet
+        self.autoscaler = autoscaler
+
+    def admin_fleet(self) -> Dict[str, Any]:
+        """GET /admin/fleet: one control-plane status document —
+        router health, fleet size/warm-pool state, autoscaler stats."""
+        out: Dict[str, Any] = {"router": self.router.health()}
+        if self.fleet is not None:
+            out["fleet"] = self.fleet.describe()
+        if self.autoscaler is not None:
+            out["autoscaler"] = self.autoscaler.stats()
+        return out
 
     @property
     def port(self) -> int:
@@ -640,9 +822,11 @@ class RouterServer(ThreadingHTTPServer):
 
 
 def make_router_server(router: Router, host: str = "127.0.0.1",
-                       port: int = 0) -> RouterServer:
+                       port: int = 0, fleet: Optional["Fleet"] = None,
+                       autoscaler=None) -> RouterServer:
     """Bind (port 0 = OS-assigned; read `server.port`)."""
-    return RouterServer((host, port), router)
+    return RouterServer((host, port), router, fleet=fleet,
+                        autoscaler=autoscaler)
 
 
 # -- replica processes + warm pool -------------------------------------------
@@ -848,9 +1032,21 @@ class Fleet:
                              ready_timeout_s=ready_timeout_s) \
             if standby > 0 else None
         self._procs: Dict[str, ReplicaProcess] = {}
+        # deliberately-retiring replicas: moved OUT of _procs (so the
+        # supervisor never mistakes the coming exit for a death and
+        # promotes a standby against the scale-down) and held here
+        # until drained + reaped
+        self._retiring: Dict[str, ReplicaProcess] = {}
+        self._scale_lock = threading.Lock()
         self._super: Optional[threading.Thread] = None
         self._stop_event = threading.Event()
         self.replaced_total = 0
+        self.retired_total = 0
+        # rollout hook (cli _serve_fleet sets this): model_dir → a
+        # spawn_fn producing replicas that serve THAT artifact with
+        # this fleet's serve flags; fleetctl/rollout.py uses it to warm
+        # the new version and to repoint standby respawns after a flip
+        self.spawn_template = None
 
     def start(self) -> "Fleet":
         # spawn the whole rotation CONCURRENTLY (model load + warmup
@@ -893,6 +1089,116 @@ class Fleet:
                         self._register(repl)
                         self.replaced_total += 1
 
+    # -- elastic capacity (the autoscaler's actuators) ------------------
+    def size(self) -> int:
+        return len(self._procs)
+
+    def describe(self) -> Dict[str, Any]:
+        return {
+            "replicas": len(self._procs),
+            "retiring": sorted(self._retiring),
+            "warm_ready": (self.warm.ready_count()
+                           if self.warm is not None else 0),
+            "standby": (self.warm.standby
+                        if self.warm is not None else 0),
+            "replaced_total": self.replaced_total,
+            "retired_total": self.retired_total,
+        }
+
+    def scale_up(self, n: int = 1) -> List[str]:
+        """Promote up to `n` warm standbys into the rotation. NON-
+        blocking: only already-/healthz-ready standbys are taken (the
+        warm pool's filler replaces them in the background), so an
+        autoscaler tick never waits out a cold model load. Returns the
+        names registered."""
+        names: List[str] = []
+        if self.warm is None:
+            return names
+        with self._scale_lock:
+            for _ in range(n):
+                p = self.warm.take(timeout=0.0)
+                if p is None:
+                    break
+                names.append(self._register(p).name)
+        return names
+
+    def scale_down(self, n: int = 1,
+                   drain_timeout_s: float = 30.0) -> List[str]:
+        """Retire the `n` least-loaded replicas: mark them draining
+        (immediately invisible to pick()), then drain + remove +
+        SIGTERM in a background thread — the caller (an autoscaler
+        tick) never blocks on the drain. At least one replica always
+        survives. Returns the names being retired."""
+        with self._scale_lock:
+            candidates = [
+                r for r in self.router.replicas()
+                if not r.draining and r.name in self._procs
+            ]
+            candidates.sort(key=lambda r: r.score())
+            n = min(n, len(self._procs) - 1)
+            victims = [r.name for r in candidates[:max(0, n)]]
+            for name in victims:
+                self.router.set_draining(name)
+                self._retiring[name] = self._procs.pop(name)
+        if victims:
+            threading.Thread(
+                target=self._drain_and_retire,
+                args=(victims, drain_timeout_s),
+                name="ptrouter-retire", daemon=True).start()
+        return victims
+
+    def retire(self, names: Sequence[str],
+               drain_timeout_s: float = 30.0) -> None:
+        """Synchronously drain + remove + terminate the named replicas
+        (the rollout's old-version drain). The names must already be
+        draining (router.flip / set_draining) — this moves their
+        processes out of supervision and reaps them."""
+        with self._scale_lock:
+            for name in names:
+                if name in self._procs:
+                    self.router.set_draining(name)
+                    self._retiring[name] = self._procs.pop(name)
+        self._drain_and_retire(list(names), drain_timeout_s)
+
+    def _drain_and_retire(self, names: Sequence[str],
+                          drain_timeout_s: float) -> None:
+        """Wait (bounded) until each named replica reports an empty
+        queue and has no router-tracked in-flight work, then remove it
+        WITH series retirement and terminate its process. In-flight
+        streams run to 'done' — SIGTERM only lands after the router
+        sees zero in-flight, and cli serve's handler drains anyway."""
+        deadline = time.monotonic() + drain_timeout_s
+        clients = {r.name: r for r in self.router.replicas()}
+        for name in names:
+            r = clients.get(name)
+            while r is not None and time.monotonic() < deadline:
+                if (r.inflight == 0
+                        and not r.snapshot.get("queue_depth", 0)):
+                    break
+                time.sleep(0.02)
+            self.router.remove_replica(name, retire_series=True)
+            p = self._retiring.pop(name, None)
+            if p is not None:
+                p.terminate()
+                if p.wait(timeout=max(5.0,
+                                      deadline - time.monotonic())) \
+                        is None:
+                    p.kill()
+            self.retired_total += 1
+
+    def set_spawn_fn(self, spawn_fn) -> None:
+        """Repoint replica creation (rollout cutover): future warm-pool
+        standbys and supervisor replacements spawn the NEW version."""
+        self.spawn_fn = spawn_fn
+        if self.warm is not None:
+            self.warm.spawn_fn = spawn_fn
+
+    def adopt(self, p: ReplicaProcess) -> ReplicaClient:
+        """Register an externally spawned, already-ready replica into
+        the rotation + supervision (rollout warms new-version replicas
+        before the router ever sees them)."""
+        return self._register(p)
+
     def stop(self, graceful: bool = False) -> None:
         self._stop_event.set()
         if self._super is not None:
@@ -900,12 +1206,14 @@ class Fleet:
         if self.warm is not None:
             self.warm.stop()
         self.router.close()
-        for p in self._procs.values():
+        procs = list(self._procs.values()) + list(self._retiring.values())
+        for p in procs:
             (p.terminate if graceful else p.kill)()
-        for p in self._procs.values():
+        for p in procs:
             if p.wait(timeout=30.0 if graceful else 10.0) is None:
                 p.kill()
         self._procs.clear()
+        self._retiring.clear()
 
 
 def replica_spawner(model_args: Sequence[str], host: str = "127.0.0.1",

@@ -122,12 +122,15 @@ class _GenRequest:
     __slots__ = ("feed", "rows", "handle", "deadline", "submitted_at",
                  "first_token_at", "last_token_at", "boots", "pes",
                  "next_row", "live_rows", "results", "failed",
-                 "request_id")
+                 "request_id", "slo_class", "enqueued_at")
 
     def __init__(self, feed, rows: int, deadline: float,
-                 request_id: Optional[str] = None):
+                 request_id: Optional[str] = None,
+                 slo_class: str = "interactive"):
         self.feed = feed
         self.rows = rows
+        self.slo_class = slo_class
+        self.enqueued_at = 0.0  # stamped by AdmissionQueue.put
         # correlation key: every span this request touches — enqueue on
         # the client thread, admit/prefix/first-token/retire on the
         # scheduler worker, the HTTP span on the handler thread —
@@ -318,7 +321,8 @@ class ContinuousScheduler:
             deadline = time.monotonic() + drain_timeout_s
             while time.monotonic() < deadline:
                 with self._cond:
-                    if not self._aq._q and not self._active.any() \
+                    # depth() is lock-free (the cond is NOT reentrant)
+                    if not self._aq.depth() and not self._active.any() \
                             and self._partial is None:
                         break
                 time.sleep(0.01)
@@ -335,7 +339,8 @@ class ContinuousScheduler:
     # -- client side ----------------------------------------------------
     def submit(self, feed: Dict[str, np.ndarray],
                timeout_ms: Optional[float] = None,
-               request_id: Optional[str] = None) -> GenHandle:
+               request_id: Optional[str] = None,
+               slo: Optional[str] = None) -> GenHandle:
         if self.breaker is not None and not self.breaker.admit():
             self.metrics.counter_inc(
                 "circuit_open_total",
@@ -353,7 +358,8 @@ class ContinuousScheduler:
         n = rows.pop()
         deadline = time.monotonic() + (
             timeout_ms / 1e3 if timeout_ms is not None else self.timeout_s)
-        req = _GenRequest(feed, n, deadline, request_id=request_id)
+        req = _GenRequest(feed, n, deadline, request_id=request_id,
+                          slo_class=slo or "interactive")
         with self._cond:
             if self._stopping:
                 raise ShedError("scheduler stopped")
@@ -546,7 +552,7 @@ class ContinuousScheduler:
     def _run(self) -> None:
         while True:
             with self._cond:
-                while (not self._aq._q and not self._active.any()
+                while (not self._aq.depth() and not self._active.any()
                        and self._partial is None and not self._stopping):
                     self._cond.wait()
                 if self._stopping:

@@ -50,6 +50,7 @@ from typing import Dict, Optional, Tuple
 import numpy as np
 
 from .. import profiler
+from ..fleetctl.tenancy import SLO_HEADER, SLOPolicy, resolve_class
 from ..obs import trace as obs_trace
 from ..resilience.breaker import STATE_CODES, CircuitBreaker, CircuitOpenError
 from .batcher import DeadlineError, MicroBatcher, ShedError
@@ -57,7 +58,7 @@ from .engine import BucketPolicy, ServingEngine
 from .metrics import MetricSet, _sanitize
 
 __all__ = ["ModelRegistry", "ServingServer", "make_server",
-           "REQUEST_ID_HEADER"]
+           "REQUEST_ID_HEADER", "SLO_HEADER"]
 
 # correlation-id header: minted (or forwarded) by the router, adopted by
 # replicas, echoed on responses — the key that stitches one request's
@@ -69,9 +70,14 @@ class ModelRegistry:
     """name → (engine, batcher). One shared MetricSet across models so
     /metrics is a single scrape."""
 
-    def __init__(self, metrics: Optional[MetricSet] = None):
+    def __init__(self, metrics: Optional[MetricSet] = None,
+                 slo_policy: Optional[SLOPolicy] = None):
         self.metrics = metrics or MetricSet(
             stat_set=profiler.global_stat_set())
+        # per-model SLO classes (fleetctl.tenancy): the model's class is
+        # the default tier of its requests; a request may demote itself
+        # (body "slo" / X-PT-SLO-Class header), never promote
+        self.slo_policy = slo_policy or SLOPolicy()
         self._models: Dict[str, Tuple[ServingEngine, MicroBatcher]] = {}
 
     def add(
@@ -169,33 +175,74 @@ class ModelRegistry:
             for n, (_, b) in self._models.items()
         }
 
-    def load(self) -> Dict[str, float]:
+    def load(self) -> Dict[str, object]:
         """Aggregate load snapshot for /healthz: admission-queue depth
-        (predict + generation), active/total decode slots, and the
-        uniform dispatch/sync counters — everything a join-shortest-
-        queue router needs to score this replica, WITHOUT the cost (or
-        parse burden) of a full /metrics scrape. All reads are advisory
-        host ints (no locks beyond what len() takes)."""
+        (predict + generation), active/total decode slots, queue age
+        (ms since the OLDEST queued request was admitted — the SLO-
+        pressure signal an autoscaler reacts to), per-SLO-class depths,
+        a per-model breakdown of the same, and the uniform dispatch/
+        sync counters — everything a join-shortest-queue router or an
+        autoscaler tick needs to score this replica, WITHOUT the cost
+        (or parse burden) of a full /metrics scrape."""
+        now = time.monotonic()
         queue_depth = active = slots = dispatches = syncs = 0
-        for e, b in self._models.values():
-            queue_depth += len(b._q)
+        classes: Dict[str, int] = {}
+        oldest: Optional[float] = None
+        first_tok_p99 = 0.0
+        per_model: Dict[str, dict] = {}
+        for n, (e, b) in self._models.items():
+            m_depth = len(b._q)
+            m_oldest = b.oldest_enqueued()
+            m_classes = b.depth_by_class()
             dispatches += e.dispatches_total
             syncs += e.syncs_total
             s = e._scheduler
             if s is not None:
-                queue_depth += s._aq.depth()
+                first_tok_p99 = max(first_tok_p99,
+                                    s._first_tok.percentile(0.99))
+                m_depth += s._aq.depth()
+                g_oldest = s._aq.oldest_enqueued()
+                if g_oldest is not None and (m_oldest is None
+                                             or g_oldest < m_oldest):
+                    m_oldest = g_oldest
+                for c, d in s._aq.depth_by_class().items():
+                    m_classes[c] = m_classes.get(c, 0) + d
                 active += int(s._active.sum())
                 slots += s.max_slots
                 dispatches += s.dispatches_total
                 syncs += s.syncs_total
+            queue_depth += m_depth
+            for c, d in m_classes.items():
+                classes[c] = classes.get(c, 0) + d
+            if m_oldest is not None and (oldest is None
+                                         or m_oldest < oldest):
+                oldest = m_oldest
+            per_model[n] = {
+                "queue_depth": m_depth,
+                "queue_age_ms": (round((now - m_oldest) * 1e3, 3)
+                                 if m_oldest is not None else 0.0),
+                "classes": m_classes,
+                "slo_class": self.slo_policy.class_of(n),
+            }
         return {
             "queue_depth": queue_depth,
+            "queue_age_ms": (round((now - oldest) * 1e3, 3)
+                             if oldest is not None else 0.0),
             "active_slots": active,
             "max_slots": slots,
             "slot_occupancy": (active / slots) if slots else 0.0,
+            "first_token_p99_ms": round(first_tok_p99 * 1e3, 3),
             "dispatches_total": dispatches,
             "syncs_total": syncs,
+            "classes": classes,
+            "models": per_model,
         }
+
+    def versions(self) -> Dict[str, str]:
+        """model → program fingerprint of the loaded artifact: the
+        identity a rollout verifies on every standby before the router
+        flips (fleetctl/rollout.py)."""
+        return {n: e.fingerprint for n, (e, _) in self._models.items()}
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -234,11 +281,15 @@ class _Handler(BaseHTTPRequestHandler):
                 "status": "degraded" if degraded else "ok",
                 "models": reg.names(),
                 "circuits": circuits,
-                # load block: queue depth + slot occupancy + dispatch
-                # counters, so a router's join-shortest-queue pick (and
-                # an operator's curl) reads load from the health probe
-                # it already makes instead of scraping full /metrics
+                # load block: queue depth/age + slot occupancy +
+                # per-class and per-model breakdowns + dispatch
+                # counters, so a router's per-class JSQ pick and an
+                # autoscaler tick read load from the health probe they
+                # already make instead of scraping full /metrics
                 "load": reg.load(),
+                # artifact identity per model: what a rollout verifies
+                # on a warmed standby before flipping the router
+                "versions": reg.versions(),
             })
         elif self.path == "/metrics":
             self._send(200, reg.metrics.render().encode(),
@@ -268,6 +319,13 @@ class _Handler(BaseHTTPRequestHandler):
                 length = int(self.headers.get("Content-Length", 0))
                 req = json.loads(self.rfile.read(length) or b"{}")
                 feed = engine.coerce_feed(req["inputs"])
+                # SLO class: the model's class (slo_policy) is the
+                # default; the request may DEMOTE itself via the
+                # X-PT-SLO-Class header (a router forwards the class it
+                # scored the pick with) or the body "slo" field
+                req["slo"] = resolve_class(
+                    reg.slo_policy.class_of(name),
+                    self.headers.get(SLO_HEADER) or req.get("slo"))
             except (ValueError, KeyError, TypeError) as e:
                 self._error(400, f"bad request: {e}")
                 return
@@ -291,7 +349,7 @@ class _Handler(BaseHTTPRequestHandler):
                                 request_id=rid):
                 outs = batcher.predict(
                     feed, timeout_ms=req.get("timeout_ms"),
-                    request_id=rid)
+                    request_id=rid, slo=req.get("slo"))
         except (ShedError, CircuitOpenError) as e:
             self._error(503, str(e))
             return
@@ -335,7 +393,7 @@ class _Handler(BaseHTTPRequestHandler):
                 with obs_trace.span("http.generate", cat="http",
                                     model=name, request_id=rid):
                     h = sched.submit(feed, timeout_ms=timeout_ms,
-                                     request_id=rid)
+                                     request_id=rid, slo=req.get("slo"))
                     budget = (timeout_ms / 1e3 if timeout_ms is not None
                               else sched.timeout_s)
                     outputs = h.result(timeout=budget + max(1.0, budget))
@@ -358,7 +416,7 @@ class _Handler(BaseHTTPRequestHandler):
         # {"event": "error"} lines (the status is already on the wire)
         try:
             handle = sched.submit(feed, timeout_ms=timeout_ms,
-                                  request_id=rid)
+                                  request_id=rid, slo=req.get("slo"))
         except (ShedError, CircuitOpenError) as e:
             self._error(503, str(e))
             return

@@ -115,6 +115,30 @@ def cpu_multiprocess_ok() -> bool:
     return ok
 
 
+# Session time budget for the `fleet` marker: multi-process fleet tests
+# are individually bounded, but a pathological environment (slow model
+# loads, starved CPU) can make the WHOLE family eat the tier-1 timeout.
+# Once the cumulative call-phase time of fleet-marked tests crosses the
+# budget, the remaining ones SKIP loudly instead of letting `timeout -k`
+# kill the run with no diagnosis. PT_FLEET_TEST_BUDGET_S=0 disables.
+_FLEET_BUDGET_S = float(os.environ.get("PT_FLEET_TEST_BUDGET_S", "420"))
+_fleet_spent = {"s": 0.0}
+
+
+def pytest_runtest_setup(item):
+    if (_FLEET_BUDGET_S > 0 and item.get_closest_marker("fleet")
+            and _fleet_spent["s"] >= _FLEET_BUDGET_S):
+        pytest.skip(
+            f"fleet test time budget exhausted "
+            f"({_fleet_spent['s']:.0f}s spent >= {_FLEET_BUDGET_S:.0f}s; "
+            "raise PT_FLEET_TEST_BUDGET_S to run everything)")
+
+
+def pytest_runtest_logreport(report):
+    if report.when == "call" and "fleet" in report.keywords:
+        _fleet_spent["s"] += report.duration
+
+
 def pytest_collection_modifyitems(config, items):
     skip_sm = pytest.mark.skip(
         reason="environment: this jax build has no jax.shard_map "
@@ -166,7 +190,9 @@ def pytest_configure(config):
         "markers",
         "fleet: multi-process router/fleet e2e tests "
         "(paddle_tpu.serving.router) that SPAWN replica subprocesses; "
-        "in tier-1 but individually time-bounded like test_chaos")
+        "in tier-1 but individually time-bounded like test_chaos, and "
+        "collectively bounded by the PT_FLEET_TEST_BUDGET_S session "
+        "budget (conftest.pytest_runtest_setup)")
     # hung multi-process / subprocess tests must leave a diagnosis: dump
     # every thread's traceback shortly before the tier-1 `timeout -k`
     # wrapper would SIGKILL the run (and again every interval for longer

@@ -509,7 +509,10 @@ def test_circuit_breaker_state_machine():
     assert not b.admit()
     clock[0] = 10.0
     assert b.state() == HALF_OPEN
+    for _ in range(3):
+        assert b.would_admit()  # read-only: never consumes the probe
     assert b.admit()          # the probe
+    assert not b.would_admit()
     assert not b.admit()      # probe budget spent
     b.record_failure()        # probe failed → re-open, timer restarts
     assert b.state() == OPEN and not b.admit()

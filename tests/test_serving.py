@@ -403,16 +403,28 @@ def test_healthz_reports_load_block(http_stack):
     dispatch/sync counters — no /metrics scrape needed."""
     reg, srv, url = http_stack
     with urllib.request.urlopen(url + "/healthz", timeout=30) as r:
-        before = json.load(r)["load"]
-    for k in ("queue_depth", "active_slots", "max_slots",
-              "slot_occupancy", "dispatches_total", "syncs_total"):
+        payload = json.load(r)
+    before = payload["load"]
+    for k in ("queue_depth", "queue_age_ms", "active_slots", "max_slots",
+              "slot_occupancy", "first_token_p99_ms", "dispatches_total",
+              "syncs_total", "classes", "models"):
         assert k in before, before
+    # per-model breakdown (ISSUE 16 satellite): each served model gets
+    # its own queue_depth/age + SLO-class split, and /healthz carries
+    # the artifact fingerprint the rollout verify gate checks
+    assert set(before["models"]) == {"default"}
+    m = before["models"]["default"]
+    for k in ("queue_depth", "queue_age_ms", "classes", "slo_class"):
+        assert k in m, m
+    assert set(before["classes"]) == {"interactive", "batch"}
+    assert payload["versions"]["default"]
     _post(url + "/predict", {"inputs": {"x": [[0.1, 0.2, 0.3, 0.4]]}})
     with urllib.request.urlopen(url + "/healthz", timeout=30) as r:
         after = json.load(r)["load"]
     assert after["dispatches_total"] > before["dispatches_total"]
     assert after["syncs_total"] > before["syncs_total"]
     assert after["queue_depth"] == 0  # nothing waiting at rest
+    assert after["queue_age_ms"] == 0.0  # empty queue has no age
 
 
 def test_predict_adopts_request_id_header(http_stack):
