@@ -57,6 +57,8 @@ Commands:
               [--max_batch_size N] [--max_wait_ms M] [--max_queue Q]
               [--timeout_ms T] [--seq_len_buckets 64,128,...] [--warmup 0|1]
               [--max_slots S] [--gen_queue Q] [--gen_timeout_ms T]
+              [--prefix_cache_mb MB [--prefix_quant int8]]
+              [--draft_model D [--draft_k K]]
               [--mesh dp1,mp2] [--drain_s S] [--quant int8]
               [--slo model=interactive|batch ...]
               [--replicas N [--standby K] [--probe_interval_ms P]
@@ -412,6 +414,11 @@ _SERVE_KNOWN = {
     "max_batch_size": str, "max_wait_ms": str, "max_queue": str,
     "timeout_ms": str, "seq_len_buckets": str, "warmup": str,
     "max_slots": str, "gen_queue": str, "gen_timeout_ms": str,
+    # generation serving v3: device-resident prefix cache +
+    # speculative decoding (forwarded to replica children so a fleet
+    # caches/drafts identically on every replica)
+    "prefix_cache_mb": str, "prefix_quant": str,
+    "draft_model": str, "draft_k": str,
     "trace_out": str, "mesh": str, "drain_s": str, "quant": str,
     # multi-tenancy: per-model SLO class specs (model=interactive|batch);
     # forwarded to replica children so admission tiers match the
@@ -480,6 +487,17 @@ def _cmd_serve(argv) -> int:
         "max_queue": int(opts.get("gen_queue", 64)),
         "timeout_ms": float(opts.get("gen_timeout_ms", 30000.0)),
     }
+    # serving v3 knobs stay absent unless asked for, so the scheduler's
+    # defaults (cache off, no draft) govern and old artifacts' sidecar
+    # draft models still auto-apply
+    if opts.get("prefix_cache_mb"):
+        scheduler_kw["prefix_cache_mb"] = float(opts["prefix_cache_mb"])
+    if opts.get("prefix_quant"):
+        scheduler_kw["prefix_cache_quant"] = opts["prefix_quant"]
+    if opts.get("draft_model"):
+        scheduler_kw["draft_model"] = opts["draft_model"]
+    if opts.get("draft_k"):
+        scheduler_kw["draft_k"] = int(opts["draft_k"])
     from .fleetctl.tenancy import SLOPolicy
 
     registry = ModelRegistry(

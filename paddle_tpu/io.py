@@ -315,8 +315,15 @@ def save_inference_model(
     executor: Optional[Executor] = None,
     main_program: Optional[Program] = None,
     scope: Optional[Scope] = None,
+    draft_model: Optional[str] = None,
 ) -> None:
-    """fluid io.py save_inference_model: pruned program + params in `dirname`."""
+    """fluid io.py save_inference_model: pruned program + params in `dirname`.
+
+    `draft_model` records a speculative-decoding companion in the
+    meta.json sidecar: the directory (relative paths resolve against
+    THIS artifact's dirname at load) of a small generation model the
+    serving scheduler drafts with by default (`serve --draft_model`
+    overrides it)."""
     program = main_program or default_main_program()
     scope = scope or global_scope()
     target_names = [
@@ -390,6 +397,8 @@ def save_inference_model(
                 **({"generation": generation} if generation else {}),
                 **({"sharding": sharding} if sharding else {}),
                 **({"quant": quant} if quant else {}),
+                **({"draft_model": {"dir": draft_model}}
+                   if draft_model else {}),
             },
             f,
         )
@@ -506,6 +515,10 @@ def load_inference_model(dirname: str, scope: Optional[Scope] = None):
     # artifacts): beam geometry + decode-state specs, consumed by
     # serving.scheduler.ContinuousScheduler warmup
     program._generation_meta = meta.get("generation") or None
+    # draft-model sidecar (absent unless exported with draft_model=...):
+    # the speculative-decoding companion dir, consumed by the serving
+    # scheduler (relative paths resolve against the artifact dir)
+    program._draft_meta = meta.get("draft_model") or None
     # sharding sidecar (absent for unsharded models): partition specs of
     # mesh-sharded parameters, re-attached to the restored vars so a
     # mesh ServingEngine (or ParallelExecutor) places them sharded

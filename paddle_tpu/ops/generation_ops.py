@@ -41,6 +41,7 @@ __all__ = [
     "GenSpec",
     "DecodeState",
     "beam_step",
+    "greedy_step",
     "find_generation_op",
     "gen_spec_from_op",
 ]
@@ -162,6 +163,30 @@ def beam_step(runner, block, spec: GenSpec, env: Dict[str, Any],
     fin_sel = jnp.take_along_axis(fin, parent, axis=1)
     new_fin = fin_sel | (new_tok == spec.eos_id)
     return sel_mems, new_tok, top_sc, new_fin, parent
+
+
+def greedy_step(runner, block, spec: GenSpec, env: Dict[str, Any],
+                mems, tok):
+    """ONE greedy (single-hypothesis) decode step over a [B] batch —
+    the DRAFT side of speculative decoding (serving/scheduler.py).
+
+    Same step sub-block contract as `beam_step` with K = 1 and no
+    beam bookkeeping: `env` must hold parameters, per-example tensors
+    at [B, ...] under `spec.per_example` names, and @RNG@/@AMP@; it is
+    mutated. `mems` are [B, ...] (no beam axis), `tok` is [B] int32.
+    Returns (new_mems, new_tok) where new_tok is the argmax of the step
+    logits — a proposal the TARGET model verifies with full `beam_step`
+    math, so draft quality only moves the accept rate, never the
+    output (verification is exact)."""
+    env[spec.prev_inner] = tok
+    for name, m in zip(spec.mem_inner, mems):
+        env[name] = m
+    runner.run_ops(block.ops, env, dict(env), block)
+    logits = env[spec.logits_inner].astype(jnp.float32)
+    new_mems = tuple(
+        env[u].reshape(m.shape) for u, m in zip(spec.mem_update, mems))
+    new_tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    return new_mems, new_tok
 
 
 @register_op("beam_search_group")

@@ -16,7 +16,13 @@ bounded). Layers, bottom-up:
 - `scheduler` — ContinuousScheduler: token-level continuous batching
               for generation models; a device-resident pool of decode
               slots stepped as one jitted program, per-step admission,
-              early-exit compaction, streaming token events.
+              early-exit compaction, streaming token events. Serving
+              v3 adds a device-resident prefix cache (prefix_cache.py,
+              fp or int8-pooled entries) and speculative decoding
+              against a small draft model (fused propose + verify).
+- `prefix_cache` — PrefixCache: byte-budgeted LRU of hot prefix
+              states keyed by raw-feed-row hash; hits admit through
+              pool_admit with zero prefix dispatches.
 - `server`  — ModelRegistry + threaded stdlib-HTTP JSON front-end
               (/predict, /generate incl. NDJSON streaming, /healthz,
               /stats, /metrics).
@@ -40,6 +46,7 @@ from .engine import BucketPolicy, ServingEngine  # noqa: F401
 from .batcher import (AdmissionQueue, DeadlineError,  # noqa: F401
                       MicroBatcher, ShedError)
 from .metrics import Histogram, MetricSet  # noqa: F401
+from .prefix_cache import PrefixCache, prefix_row_key  # noqa: F401
 from .scheduler import (ContinuousScheduler, GenerationAborted,  # noqa: F401
                         GenHandle)
 from .server import (REQUEST_ID_HEADER, ModelRegistry,  # noqa: F401
@@ -69,6 +76,8 @@ __all__ = [
     "ContinuousScheduler",
     "GenHandle",
     "GenerationAborted",
+    "PrefixCache",
+    "prefix_row_key",
     "MetricSet",
     "Histogram",
     "ModelRegistry",

@@ -143,6 +143,7 @@ class ServingEngine:
         quantize: Optional[str] = None,
     ):
         self.model_name = model_name
+        self.model_dir = model_dir
         self.policy = policy or BucketPolicy()
         self.scope = Scope()
         self.program, self.feed_names, self.fetch_names = (
@@ -250,6 +251,11 @@ class ServingEngine:
         # the scheduler can allocate its slot pool without re-tracing
         self.generation_meta = getattr(self.program, "_generation_meta",
                                        None)
+        # draft-model sidecar (io.save_inference_model(draft_model=...)
+        # since serving v3): the exporter's recommended speculative-
+        # decoding companion; the scheduler resolves it relative to
+        # model_dir unless overridden by --draft_model
+        self.draft_meta = getattr(self.program, "_draft_meta", None)
         from ..ops import generation_ops as _G
 
         _gen_op = _G.find_generation_op(self.program)

@@ -25,7 +25,8 @@ from ..obs.metrics import (DEFAULT_LATENCY_BUCKETS, Histogram,  # noqa: F401
                            _sanitize)
 
 __all__ = ["Histogram", "MetricSet", "DEFAULT_LATENCY_BUCKETS",
-           "FIRST_TOKEN_BUCKETS", "TOKEN_INTERVAL_BUCKETS"]
+           "FIRST_TOKEN_BUCKETS", "TOKEN_INTERVAL_BUCKETS",
+           "VERIFY_ROUND_BUCKETS"]
 
 # generation-serving latency grids (continuous batching): first-token
 # latency is queue wait + prefix run + one pool step (ms to seconds —
@@ -38,6 +39,13 @@ FIRST_TOKEN_BUCKETS = (
 TOKEN_INTERVAL_BUCKETS = (
     0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
     0.5, 1.0,
+)
+# one speculative round = draft propose dispatch + target verify
+# dispatch + one d2h fence; moves up to draft_k tokens per slot, so the
+# grid sits between the per-token and first-token grids.
+VERIFY_ROUND_BUCKETS = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+    1.0, 2.5,
 )
 
 

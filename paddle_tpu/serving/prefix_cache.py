@@ -1,0 +1,142 @@
+"""Device-resident prefix cache for generation serving (serving v3).
+
+Real generation traffic is massively redundant — shared system prompts,
+common query prefixes, retried requests — yet before this cache every
+admission recomputed the full encoder prefix before `pool_admit` copied
+the boot state into a decode slot. The cache closes that loop: the
+TOKEN PREFIX of a request (its raw feed row) is hashed, and the hot
+`(boots, pe_rows)` prefix states live in an LRU pool in HBM. A hit
+admits by copying the pooled state into a free slot through the same
+jitted dynamic-update path a fresh prefix uses — no prefix dispatch at
+all, which is where the first-token-p99 collapse on shared-prefix
+traffic comes from.
+
+Two storage modes:
+
+- fp     — entries hold the prefix program's own output arrays. A
+           cache-hit admission is BIT-IDENTICAL to a fresh-prefix
+           admission (same values through the same `pool_admit`
+           dynamic-update; tests/test_gen_v3.py pins this).
+- int8   — entries hold per-tensor symmetric int8 payloads + f32
+           scales (the `paddle_tpu/quant` recipe: absmax/127, round,
+           clip), dequantized INSIDE the jitted admit copy. The same
+           HBM budget holds ~4x more f32-state prefixes (2x for bf16
+           states); admission is approximate with a bounded delta.
+
+The class is host-side bookkeeping only (an OrderedDict of opaque
+device payloads + byte accounting); quantize/dequant programs live in
+the scheduler next to `pool_admit`, where the slot geometry is known.
+`get()` is on the admission hot path — it does a dict move and two
+counter bumps, nothing else (the zero-cost lint in tests/test_obs.py
+covers it).
+"""
+
+from __future__ import annotations
+
+import hashlib
+from collections import OrderedDict
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+__all__ = ["PrefixCache", "prefix_row_key"]
+
+
+def prefix_row_key(model_fingerprint: str, feed: Dict[str, Any],
+                   row: int) -> str:
+    """Cache identity of ONE request row: sha256 over the model's
+    program fingerprint plus every feed's (name, dtype, shape, bytes)
+    for that row. Scalar (0-d) feeds hash whole — they are shared
+    across rows by construction. Hashing the RAW feed (not the padded
+    bucket) means two requests that differ only in their batch
+    neighbours still share an entry."""
+    h = hashlib.sha256()
+    h.update(model_fingerprint.encode())
+    for name in sorted(feed):
+        v = np.asarray(feed[name])
+        r = v if v.ndim == 0 else v[row]
+        r = np.ascontiguousarray(r)
+        h.update(name.encode())
+        h.update(str(r.dtype).encode())
+        h.update(str(r.shape).encode())
+        h.update(r.tobytes())
+    return h.hexdigest()
+
+
+class PrefixCache:
+    """Byte-budgeted LRU of device-resident prefix states.
+
+    Payloads are opaque to the cache (tuples of device arrays, plus
+    scales in int8 mode); `nbytes` is accounted by the caller because
+    only it knows which leaves are device-resident. An entry larger
+    than the whole budget is refused (counted as an overflow, never
+    admitted, never evicts the working set for one giant request)."""
+
+    def __init__(self, capacity_bytes: int):
+        if capacity_bytes <= 0:
+            raise ValueError(
+                f"prefix cache capacity must be positive, got "
+                f"{capacity_bytes} bytes")
+        self.capacity_bytes = int(capacity_bytes)
+        self._entries: "OrderedDict[str, tuple]" = OrderedDict()
+        self.bytes = 0
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+        self.insertions = 0
+        self.overflows = 0
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def __contains__(self, key: str) -> bool:
+        # membership probe WITHOUT hit/miss accounting or LRU motion
+        # (insert-path dedup, not a lookup)
+        return key in self._entries
+
+    def get(self, key: str) -> Optional[dict]:
+        # HOT PATH (admission): dict move + counters only
+        ent = self._entries.get(key)
+        if ent is None:
+            self.misses += 1
+            return None
+        self._entries.move_to_end(key)
+        self.hits += 1
+        return ent[0]
+
+    def put(self, key: str, payload: dict, nbytes: int) -> int:
+        """Insert (or refresh) an entry; returns the number of LRU
+        entries evicted to fit it."""
+        if nbytes > self.capacity_bytes:
+            self.overflows += 1
+            return 0
+        old = self._entries.pop(key, None)
+        if old is not None:
+            self.bytes -= old[1]
+        evicted = 0
+        while self._entries and self.bytes + nbytes > self.capacity_bytes:
+            _, (_, ev_bytes) = self._entries.popitem(last=False)
+            self.bytes -= ev_bytes
+            self.evictions += 1
+            evicted += 1
+        self._entries[key] = (payload, nbytes)
+        self.bytes += nbytes
+        self.insertions += 1
+        return evicted
+
+    def hit_rate(self) -> float:
+        total = self.hits + self.misses
+        return self.hits / total if total else 0.0
+
+    def stats(self) -> Dict[str, Any]:
+        return {
+            "entries": len(self._entries),
+            "bytes": self.bytes,
+            "capacity_bytes": self.capacity_bytes,
+            "hits": self.hits,
+            "misses": self.misses,
+            "hit_rate": round(self.hit_rate(), 4),
+            "evictions": self.evictions,
+            "insertions": self.insertions,
+            "overflows": self.overflows,
+        }

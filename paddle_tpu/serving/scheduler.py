@@ -38,13 +38,40 @@ failures so /generate trips the same breaker /predict does. The
 `serving.predict` fault point is fired each pool step: an injected
 fault aborts in-flight requests with GenerationAborted (503, retryable)
 and recovers the slots for subsequent traffic.
+
+Generation serving v3 adds two levers on top of the slot pool:
+
+- PREFIX CACHE (`prefix_cache_mb`) — the raw feed row is hashed and
+  hot prefix states (boots + per-example rows) stay device-resident in
+  a byte-budgeted LRU (serving/prefix_cache.py). A hit admits by
+  copying the pooled state into the slot through the SAME `pool_admit`
+  dynamic-update a fresh prefix uses — zero prefix dispatches, so the
+  first token of a shared-prefix request costs one pool step. With
+  `prefix_cache_quant="int8"` entries are stored int8-quantized
+  (per-tensor symmetric, the quant/ recipe) and dequantized inside the
+  jitted admit copy: ~4x more cached prefixes per HBM byte, at a
+  bounded admit delta (the fp mode stays bit-identical).
+
+- SPECULATIVE DECODING (`draft_model`) — a small draft model proposes
+  `draft_k` tokens per slot greedily (one fused scan), and the target
+  verifies all of them in ONE jitted `pool_verify` scan of the same
+  `beam_step` the pool step runs. Per-slot halt masks stop a slot's
+  advance at the first draft/target mismatch — KEEPING the divergent
+  target token, so every applied step is an unconditioned `beam_step`
+  and the output is structurally bit-identical to plain decoding for
+  ANY accept pattern (a rejected draft degrades to exactly one plain
+  step). The win on a recurrent step net is dispatch fusion: one
+  draft dispatch + one verify dispatch + ONE d2h fence move up to
+  `draft_k` tokens per slot, vs one dispatch + fence per token.
 """
 
 from __future__ import annotations
 
+import os
 import queue
 import threading
 import time
+from collections import OrderedDict
 from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
@@ -54,7 +81,8 @@ from ..resilience import faults
 from ..resilience.breaker import CircuitBreaker, CircuitOpenError
 from .batcher import AdmissionQueue, DeadlineError, ShedError
 from .metrics import (FIRST_TOKEN_BUCKETS, TOKEN_INTERVAL_BUCKETS,
-                      MetricSet)
+                      VERIFY_ROUND_BUCKETS, MetricSet)
+from .prefix_cache import PrefixCache, prefix_row_key
 
 __all__ = ["ContinuousScheduler", "GenHandle", "GenerationAborted",
            "DeadlineError", "ShedError", "CircuitOpenError"]
@@ -121,6 +149,7 @@ class GenHandle:
 class _GenRequest:
     __slots__ = ("feed", "rows", "handle", "deadline", "submitted_at",
                  "first_token_at", "last_token_at", "boots", "pes",
+                 "dboots", "dpes", "cached", "cache_keys",
                  "next_row", "live_rows", "results", "failed",
                  "request_id", "slo_class", "enqueued_at")
 
@@ -146,6 +175,10 @@ class _GenRequest:
         self.last_token_at: Optional[float] = None
         self.boots = None  # prefix outputs, set at first admission
         self.pes = None
+        self.dboots = None  # draft-model prefix outputs (spec decoding)
+        self.dpes = None
+        self.cached = None  # row -> PrefixCache entry (cache-hit rows)
+        self.cache_keys = None  # row -> cache key (for miss insertion)
         self.next_row = 0  # next un-admitted row
         self.live_rows = 0  # rows currently holding slots
         self.results: Dict[int, tuple] = {}  # row -> (ids, scores, lengths)
@@ -170,6 +203,11 @@ class ContinuousScheduler:
         timeout_ms: float = 30000.0,
         breaker: Optional[CircuitBreaker] = None,
         metrics: Optional[MetricSet] = None,
+        prefix_cache_mb: float = 0.0,
+        prefix_cache_quant: Optional[str] = None,
+        draft_model: Optional[str] = None,
+        draft_k: int = 4,
+        max_prefix_programs: int = 32,
     ):
         from ..ops import generation_ops as G
 
@@ -219,8 +257,55 @@ class ContinuousScheduler:
         self._pe_specs = None
         self._pool_step = None  # jitted (params, active, state) -> state
         self._pool_admit = None  # jitted (state, slot, boots, pes) -> state
-        self._prefix_cache: Dict[tuple, Any] = {}
+        self._pool_admit_q = None  # int8-entry admit (dequant fused)
+        self._q_rows = None  # jitted per-tensor int8 row quantizer
+        self._pool_verify = None  # speculative D-step verify scan
+        # jitted prefix-PROGRAM cache: LRU-capped on program count
+        # (satellite of serving v3 — the padded-shape-keyed dict was
+        # unbounded, so a tail of novel shapes pinned every traced
+        # program forever). Evictions land on the UNIFIED pt_ registry,
+        # mirroring the predict path's compile-cache accounting.
+        if max_prefix_programs < 1:
+            raise ValueError(
+                f"max_prefix_programs must be >= 1, got "
+                f"{max_prefix_programs}")
+        self.max_prefix_programs = max_prefix_programs
+        self._prefix_cache: "OrderedDict[tuple, Any]" = OrderedDict()
+        self.prefix_program_evictions = 0
+        self.metrics.registry.declare_counter(
+            "pt_gen_prefix_evictions_total",
+            help="jitted generation prefix programs evicted from the "
+                 "scheduler's LRU compile cache")
         self.compiles = 0
+
+        # device-resident prefix-STATE cache (serving v3 tentpole):
+        # raw-feed-row hash -> pooled (boots, pe_rows) in HBM; a hit
+        # admits via pool_admit with zero prefix dispatches
+        if prefix_cache_quant not in (None, "int8"):
+            raise ValueError(
+                f"unsupported prefix_cache_quant {prefix_cache_quant!r} "
+                "(only 'int8')")
+        self.prefix_cache_quant = prefix_cache_quant
+        self._pcache = (PrefixCache(int(prefix_cache_mb * (1 << 20)))
+                        if prefix_cache_mb > 0 else None)
+
+        # speculative decoding (serving v3 tentpole): the draft rig is
+        # built up front so a bad --draft_model fails at construction,
+        # not on the first request. CLI knob overrides the artifact's
+        # draft-model sidecar (io.save_inference_model(draft_model=...))
+        if draft_k < 1:
+            raise ValueError(f"draft_k must be >= 1, got {draft_k}")
+        self.draft_k = int(draft_k)
+        self._draft = None
+        sidecar = getattr(engine, "draft_meta", None) or {}
+        draft_dir = draft_model or sidecar.get("dir")
+        if draft_dir and not os.path.isabs(draft_dir) \
+                and getattr(engine, "model_dir", None):
+            cand = os.path.join(engine.model_dir, draft_dir)
+            if os.path.isdir(cand):
+                draft_dir = cand
+        if draft_dir:
+            self._init_draft(draft_dir)
 
         self._cond = threading.Condition()
         # the admission queue shares MicroBatcher's deadline/shed
@@ -268,14 +353,65 @@ class ContinuousScheduler:
             "circuit_open_total",
             help="requests rejected because the model's circuit "
                  "breaker was open")
+        # serving v3 surfaces (pre-registered even when the feature is
+        # off, so the scrape surface never depends on configuration)
+        self.metrics.declare_counter(
+            "gen_prefix_hits_total",
+            help="request rows admitted from the device-resident "
+                 "prefix cache (no prefix dispatch)")
+        self.metrics.declare_counter(
+            "gen_prefix_misses_total",
+            help="request rows that ran the full prefix program")
+        self.metrics.declare_counter(
+            "gen_prefix_cache_evictions_total",
+            help="prefix states evicted from the device-resident LRU "
+                 "(byte budget pressure)")
+        self.metrics.declare_counter(
+            "gen_draft_tokens_total",
+            help="tokens proposed by the draft model")
+        self.metrics.declare_counter(
+            "gen_draft_accepted_total",
+            help="proposed tokens converted to emitted target tokens "
+                 "(the divergence-correcting target step included)")
+        self.metrics.declare_counter(
+            "gen_verify_rounds_total",
+            help="speculative verify rounds (one draft dispatch + one "
+                 "target verify dispatch each)")
+        self.verify_rounds_total = 0
+        self._draft_proposed = 0
+        self._draft_accepted = 0
+        self._verify_lat = self.metrics.histogram(
+            "gen_verify_round_seconds", buckets=VERIFY_ROUND_BUCKETS,
+            help="latency of one speculative round (draft propose + "
+                 "target verify + fence)")
+        self.metrics.gauge(
+            "gen_prefix_cache_entries",
+            lambda: float(len(self._pcache)) if self._pcache else 0.0,
+            help="prefix states resident in the device LRU")
+        self.metrics.gauge(
+            "gen_prefix_cache_bytes",
+            lambda: float(self._pcache.bytes) if self._pcache else 0.0,
+            help="HBM bytes held by cached prefix states")
+        self.metrics.gauge(
+            "gen_prefix_hit_rate",
+            lambda: self._pcache.hit_rate() if self._pcache else 0.0,
+            help="prefix cache hit rate since start")
+        self.metrics.gauge(
+            "gen_accept_rate",
+            lambda: (self._draft_accepted / self._draft_proposed
+                     if self._draft_proposed else 0.0),
+            help="fraction of the drafted window converted to emitted "
+                 "tokens (tokens-per-round / draft_k)")
 
-    def _check_step_closures(self, program) -> None:
+    def _check_step_closures(self, program, spec=None) -> None:
         """The pool-step env holds parameters and declared per-example
         tensors ONLY (batch-mode decode sees the whole block-0 env, so
         it tolerates undeclared closures the scheduler cannot): reject
         step bodies that close over other outer values up front, with a
-        fix, instead of a KeyError mid-trace."""
-        spec = self.spec
+        fix, instead of a KeyError mid-trace. Also applied to the
+        draft model's step body (its propose scan has the same env
+        contract)."""
+        spec = spec or self.spec
         persist = {v.name for v in program.persistables()}
         produced = ({spec.prev_inner} | set(spec.mem_inner)
                     | set(spec.per_example))
@@ -297,6 +433,134 @@ class ContinuousScheduler:
                 f"value(s) {missing}: continuous batching keeps only "
                 "parameters and declared per-example tensors device-"
                 "resident — declare them with gen.per_example_input()")
+
+    # -- speculative decoding rig ---------------------------------------
+    def _init_draft(self, draft_dir: str) -> None:
+        """Load + validate the draft model and resolve everything the
+        fused propose program needs (runner, step block, device-placed
+        params). Fails at construction, not on the first request."""
+        from .engine import ServingEngine
+        from ..core.executor import _BlockRunner
+
+        d_eng = ServingEngine(
+            draft_dir, policy=self.engine.policy,
+            model_name=f"{self.engine.model_name}.draft",
+            metrics=self.metrics)
+        dspec = d_eng.generation_spec()
+        if dspec is None:
+            raise ValueError(
+                f"draft model {draft_dir!r} has no beam_search_group "
+                "op — speculative decoding drafts with a (small) "
+                "generation model over the same vocabulary")
+        spec = self.spec
+        if (dspec.bos_id, dspec.eos_id) != (spec.bos_id, spec.eos_id):
+            raise ValueError(
+                f"draft model {draft_dir!r} decodes with "
+                f"bos/eos=({dspec.bos_id},{dspec.eos_id}) but the "
+                f"target uses ({spec.bos_id},{spec.eos_id}) — draft "
+                "proposals would never verify")
+        if sorted(d_eng.feed_names) != sorted(self.engine.feed_names):
+            raise ValueError(
+                f"draft model feeds {sorted(d_eng.feed_names)} != "
+                f"target feeds {sorted(self.engine.feed_names)}: the "
+                "draft prefix runs on the SAME request feed")
+        self._check_step_closures(d_eng.program, dspec)
+        jax = self._jax
+        prog = d_eng.program
+        op = self._G.find_generation_op(prog)
+        block0 = prog.global_block()
+        gen_idx = block0.ops.index(op)
+        self._draft = {
+            "engine": d_eng,
+            "dir": draft_dir,
+            "spec": dspec,
+            "params": {
+                v.name: jax.device_put(d_eng.scope.get(v.name))
+                for v in prog.persistables() if d_eng.scope.has(v.name)
+            },
+            "prefix_ops": block0.ops[:gen_idx],
+            "block0": block0,
+            "runner": _BlockRunner(prog),
+            "block": prog.blocks[dspec.sub_block],
+            "amp": prog.amp_dtype,
+            # slot-pool state (allocated by _ensure_draft_pool)
+            "mem_specs": None, "pe_specs": None,
+            "mems": None, "tok": None, "pe": None,
+            "admit": None, "admit_q": None, "propose": None,
+        }
+
+    def _ensure_draft_pool(self, dmem_specs, dpe_specs) -> None:
+        """Allocate the draft's single-hypothesis slot state (mems
+        [S, ...], last-token [S], per-example [S, ...]) and compile its
+        admit + fused D-step propose programs. The propose scan's mems
+        HISTORY feeds pool_verify's draft-sync gather: after `a`
+        accepted steps the draft state that consumed the accepted
+        tokens is exactly the state after propose step `a` (accepted
+        means the proposals MATCHED the emitted tokens), so syncing is
+        a per-slot select, never a replay."""
+        d = self._draft
+        if d["mems"] is not None:
+            if (dmem_specs, dpe_specs) != (d["mem_specs"], d["pe_specs"]):
+                raise ValueError(
+                    f"draft state geometry changed mid-serve: pool "
+                    f"holds {d['mem_specs']}/{d['pe_specs']}, request "
+                    f"produced {dmem_specs}/{dpe_specs}")
+            return
+        jax, jnp = self._jax, self._jax.numpy
+        G, S, D = self._G, self.max_slots, self.draft_k
+        dspec, runner, block = d["spec"], d["runner"], d["block"]
+        amp = d["amp"]
+        d["mem_specs"], d["pe_specs"] = dmem_specs, dpe_specs
+        d["mems"] = tuple(
+            jnp.zeros((S,) + shp, dt) for shp, dt in dmem_specs)
+        d["tok"] = jnp.full((S,), dspec.bos_id, jnp.int32)
+        d["pe"] = tuple(
+            jnp.zeros((S,) + shp, dt) for shp, dt in dpe_specs)
+
+        def d_admit_body(mems, tok, pe, slot, boots, pe_rows):
+            mems = tuple(
+                jax.lax.dynamic_update_index_in_dim(m, b, slot, 0)
+                for m, b in zip(mems, boots))
+            tok = jax.lax.dynamic_update_index_in_dim(
+                tok, jnp.int32(dspec.bos_id), slot, 0)
+            pe = tuple(
+                jax.lax.dynamic_update_index_in_dim(p, r, slot, 0)
+                for p, r in zip(pe, pe_rows))
+            return mems, tok, pe
+
+        def d_admit_q(mems, tok, pe, slot, qboots, bscales, qpes,
+                      pscales):
+            boots = tuple(
+                (q.astype(jnp.float32) * s).astype(dt)
+                for q, s, (_, dt) in zip(qboots, bscales, dmem_specs))
+            pe_rows = tuple(
+                (q.astype(jnp.float32) * s).astype(dt)
+                for q, s, (_, dt) in zip(qpes, pscales, dpe_specs))
+            return d_admit_body(mems, tok, pe, slot, boots, pe_rows)
+
+        def d_propose(dparams, mems, tok, pe):
+            """D greedy steps; returns (drafts [D, S], per-mem history
+            [D, S, ...]) — history row i is the state AFTER consuming
+            proposal i's input, the sync source for pool_verify."""
+            def body(carry, _):
+                m, t = carry
+                env = dict(dparams)
+                env["@RNG@"] = jax.random.PRNGKey(0)
+                env["@RNG_COUNTER@"] = 0
+                env["@AMP@"] = amp
+                for name, v in zip(dspec.per_example, pe):
+                    env[name] = v
+                nm, nt = G.greedy_step(runner, block, dspec, env, m, t)
+                return (nm, nt), (nt, nm)
+
+            (_, _), (drafts, hist) = jax.lax.scan(
+                body, (mems, tok), jnp.arange(D, dtype=jnp.int32))
+            return drafts, hist
+
+        d["admit"] = jax.jit(d_admit_body)
+        d["admit_q"] = jax.jit(d_admit_q)
+        d["propose"] = jax.jit(d_propose)
+        self.compiles += 2
 
     # -- lifecycle ------------------------------------------------------
     def start(self) -> "ContinuousScheduler":
@@ -384,20 +648,36 @@ class ContinuousScheduler:
         return h.result(timeout=budget + max(1.0, budget))
 
     # -- pool construction ---------------------------------------------
-    def _build_prefix(self, padded: Dict[str, Any]):
+    def _build_prefix(self, padded: Dict[str, Any], draft: bool = False):
         """Jitted encoder prefix: (params, feed) -> (boots, pes); one
         compile per engine shape bucket (the slot-state compile cache is
-        keyed off the SAME buckets predict uses)."""
+        keyed off the SAME buckets predict uses). `draft=True` builds
+        the same program over the DRAFT model's prefix ops (speculative
+        decoding boots draft slot state from the same request feed).
+
+        The program cache is a count-capped LRU (max_prefix_programs):
+        a tail of novel padded shapes evicts the coldest traced program
+        instead of pinning every one forever; evictions are counted on
+        the unified registry (pt_gen_prefix_evictions_total)."""
         from ..core.executor import _BlockRunner, _feed_signature
 
         key = _feed_signature(padded)
+        if draft:
+            key = ("draft",) + key
         fn = self._prefix_cache.get(key)
         if fn is not None:
+            self._prefix_cache.move_to_end(key)
             return fn
         jax, jnp = self._jax, self._jax.numpy
-        runner = _BlockRunner(self.engine.program)
-        spec, block0, ops = self.spec, self._block0, self._prefix_ops
-        amp = self.engine.program.amp_dtype
+        if draft:
+            d = self._draft
+            runner, spec = d["runner"], d["spec"]
+            block0, ops = d["block0"], d["prefix_ops"]
+            amp = d["amp"]
+        else:
+            runner = _BlockRunner(self.engine.program)
+            spec, block0, ops = self.spec, self._block0, self._prefix_ops
+            amp = self.engine.program.amp_dtype
 
         def prefix(params, feed):
             env = dict(params)
@@ -411,6 +691,13 @@ class ContinuousScheduler:
             return boots, pes
 
         fn = jax.jit(prefix)
+        while len(self._prefix_cache) >= self.max_prefix_programs:
+            self._prefix_cache.popitem(last=False)
+            self.prefix_program_evictions += 1
+            self.metrics.registry.counter_inc(
+                "pt_gen_prefix_evictions_total",
+                help="jitted generation prefix programs evicted from "
+                     "the scheduler's LRU compile cache")
         self._prefix_cache[key] = fn
         self.compiles += 1
         return fn
@@ -496,9 +783,117 @@ class ContinuousScheduler:
             return state._replace(mems=mems, tok=tok, scores=sc, fin=fin,
                                   step=stp, pe=pe)
 
+        def pool_admit_q(state, slot, qboots, bscales, qpes, pscales):
+            # int8-pooled cache entry: dequant FUSED into the admit copy
+            # (the f32 intermediates never round-trip through HBM as a
+            # separate dispatch) — the quant/ per-tensor symmetric
+            # recipe: x ≈ q * scale, scale = absmax/127
+            boots = tuple(
+                (q.astype(jnp.float32) * s).astype(dt)
+                for q, s, (_, dt) in zip(qboots, bscales, mem_specs))
+            pe_rows = tuple(
+                (q.astype(jnp.float32) * s).astype(dt)
+                for q, s, (_, dt) in zip(qpes, pscales, pe_specs))
+            return pool_admit(state, slot, boots, pe_rows)
+
+        def q_rows(boots, pe_rows):
+            # per-tensor symmetric int8 for ONE request row's prefix
+            # state (quant_kernels recipe: absmax/INT8_MAX scale,
+            # round + clip) — runs once per cache insertion
+            from ..ops.quant_kernels import INT8_MAX
+
+            def q(x):
+                xf = x.astype(jnp.float32)
+                scale = jnp.maximum(
+                    jnp.max(jnp.abs(xf)), 1e-30) / INT8_MAX
+                qv = jnp.clip(jnp.round(xf / scale),
+                              -INT8_MAX, INT8_MAX).astype(jnp.int8)
+                return qv, scale
+
+            qb = [q(b) for b in boots]
+            qp = [q(p) for p in pe_rows]
+            return (tuple(v for v, _ in qb), tuple(s for _, s in qb),
+                    tuple(v for v, _ in qp), tuple(s for _, s in qp))
+
         self._pool_step = jax.jit(pool_step)
         self._pool_admit = jax.jit(pool_admit)
         self.compiles += 2
+        if self._pcache is not None and self.prefix_cache_quant == "int8":
+            self._pool_admit_q = jax.jit(pool_admit_q)
+            self._q_rows = jax.jit(q_rows)
+            self.compiles += 2
+
+        if self._draft is not None:
+            D = self.draft_k
+
+            def pool_verify(params, active, state, drafts, hist,
+                            dmems, dtok):
+                """ONE speculative round: scan `beam_step` D times with
+                a per-slot go mask. Every APPLIED step is the exact
+                pool_step update (same beam_step, same masked writes,
+                same trellis column), so the emitted stream is
+                bit-identical to plain decoding for any accept pattern;
+                `go` only decides HOW MANY of the D steps apply. A slot
+                halts at its first draft/target mismatch — KEEPING the
+                divergent target token — and at finish/max_len (so the
+                emitted-token count matches plain mode exactly)."""
+                def body(carry, i):
+                    st, go = carry
+                    env = dict(params)
+                    env["@RNG@"] = jax.random.PRNGKey(0)
+                    env["@RNG_COUNTER@"] = 0
+                    env["@AMP@"] = amp
+                    for name, v in zip(spec.per_example, st.pe):
+                        env[name] = v
+                    new_mems, new_tok, new_sc, new_fin, parent = \
+                        G.beam_step(runner, block, spec, env,
+                                    st.mems, st.tok, st.scores, st.fin)
+                    u2 = go[:, None]
+                    mems = tuple(
+                        jnp.where(
+                            go.reshape((S,) + (1,) * (m.ndim - 1)), nm, m)
+                        for nm, m in zip(new_mems, st.mems))
+                    tok = jnp.where(u2, new_tok, st.tok)
+                    sc = jnp.where(u2, new_sc, st.scores)
+                    fin = jnp.where(u2, new_fin, st.fin)
+                    at_t = (jnp.arange(T)[None, None, :]
+                            == st.step[:, None, None]) & go[:, None, None]
+                    parents = jnp.where(at_t, parent[:, :, None],
+                                        st.parents)
+                    ttok = jnp.where(at_t, new_tok[:, :, None],
+                                     st.trellis_tok)
+                    stp = st.step + go.astype(jnp.int32)
+                    nst = G.DecodeState(mems, tok, sc, fin, stp,
+                                        parents, ttok, st.pe)
+                    matched = new_tok[:, 0] == drafts[i]
+                    go = (go & matched & (stp < T)
+                          & ~fin.all(axis=1))
+                    return (nst, go), None
+
+                (st, _), _ = jax.lax.scan(
+                    body, (state, active),
+                    jnp.arange(D, dtype=jnp.int32))
+                adv = st.step - state.step  # [S] applied steps, 0..D
+                # draft sync fused in: after `a` applied steps the
+                # draft state that consumed the emitted tokens is
+                # exactly propose-history row a-1 (inputs dtok,
+                # drafts[0..a-2] — all but the last emitted token,
+                # which becomes the next round's dtok)
+                moved = adv > 0
+                idx = jnp.maximum(adv - 1, 0)
+                new_dmems = tuple(
+                    jnp.where(
+                        moved.reshape((S,) + (1,) * (dm.ndim - 1)),
+                        jnp.take_along_axis(
+                            h, idx.reshape((1, S) + (1,) * (h.ndim - 2)),
+                            axis=0)[0],
+                        dm)
+                    for h, dm in zip(hist, dmems))
+                new_dtok = jnp.where(moved, st.tok[:, 0], dtok)
+                return st, new_dmems, new_dtok, adv
+
+            self._pool_verify = jax.jit(pool_verify)
+            self.compiles += 1
 
     def warmup(self) -> int:
         """Pre-compile the slot machinery so the first live request
@@ -538,14 +933,57 @@ class ContinuousScheduler:
                 self._state, jnp.int32(0), boots, pes)
             # leave the pool empty: the warmup admit wrote slot 0 but
             # _active stays False so its garbage never steps or retires
+            if self._pool_admit_q is not None:
+                # int8 cache machinery: row quantizer + dequant-admit
+                qb, bs, qp, ps = self._q_rows(boots, pes)
+                self._state = self._pool_admit_q(
+                    self._state, jnp.int32(0), qb, bs, qp, ps)
+        if self._draft is not None and self._draft["mems"] is None:
+            # the draft artifact's own generation meta gives its state
+            # geometry without running a request through it
+            dmeta = getattr(self._draft["engine"].program,
+                            "_generation_meta", None)
+            if dmeta:
+                try:
+                    dmem_specs = tuple(
+                        (tuple(int(x) for x in m["shape"]),
+                         np.dtype(m["dtype"]))
+                        for m in dmeta.get("state", []))
+                    dpe_specs = tuple(
+                        (tuple(int(x) for x in m["shape"]),
+                         np.dtype(m["dtype"]))
+                        for m in dmeta.get("per_example", []))
+                    self._ensure_draft_pool(dmem_specs, dpe_specs)
+                except (KeyError, TypeError, ValueError):
+                    pass  # draft pool compiles on first request instead
+        if self._draft is not None and self._draft["mems"] is not None:
+            jnp = self._jax.numpy
+            d = self._draft
+            db = tuple(jnp.zeros(shp, dt) for shp, dt in d["mem_specs"])
+            dpr = tuple(jnp.zeros(shp, dt) for shp, dt in d["pe_specs"])
+            d["mems"], d["tok"], d["pe"] = d["admit"](
+                d["mems"], d["tok"], d["pe"], jnp.int32(0), db, dpr)
+            drafts, hist = d["propose"](
+                d["params"], d["mems"], d["tok"], d["pe"])
+            if self._state is not None and self._pool_verify is not None:
+                # all-False mask: traces the verify scan, changes nothing
+                active = jnp.zeros((self.max_slots,), bool)
+                st, ndm, ndt, _ = self._pool_verify(
+                    self._params, active, self._state, drafts, hist,
+                    d["mems"], d["tok"])
+                self._state = st
+                d["mems"], d["tok"] = ndm, ndt
         pol = self.engine.policy
         for nb in pol.batch_buckets:
             for tb in (pol.seq_len_buckets or (None,)):
                 feed = self.engine._zero_bucket_feed(nb, tb)
                 if feed is None:
                     continue
-                self._build_prefix(
-                    {k: self._jax.numpy.asarray(v) for k, v in feed.items()})
+                padded = {k: self._jax.numpy.asarray(v)
+                          for k, v in feed.items()}
+                self._build_prefix(padded)
+                if self._draft is not None:
+                    self._build_prefix(padded, draft=True)
         return self.compiles - before
 
     # -- worker ---------------------------------------------------------
@@ -567,7 +1005,10 @@ class ContinuousScheduler:
 
                 traceback.print_exc()
             if self._active.any():
-                self._step_once()
+                if self._draft is not None:
+                    self._spec_round()
+                else:
+                    self._step_once()
             else:
                 time.sleep(0.001)  # queue non-empty but nothing admitted
 
@@ -616,6 +1057,41 @@ class ContinuousScheduler:
                 return  # head-of-line request still owns the next slots
 
     def _run_prefix(self, req: _GenRequest) -> None:
+        d = self._draft
+        if self._pcache is not None:
+            # device prefix-state cache probe: per-ROW raw-feed hash, so
+            # a request shares entries regardless of batch neighbours
+            keys = [prefix_row_key(self.engine.fingerprint, req.feed, r)
+                    for r in range(req.rows)]
+            req.cache_keys = keys
+            ents = [self._pcache.get(k) for k in keys]
+            hits = sum(e is not None for e in ents)
+            misses = req.rows - hits
+            if hits:
+                self.metrics.counter_inc(
+                    "gen_prefix_hits_total", by=float(hits),
+                    help="request rows admitted from the device-"
+                         "resident prefix cache (no prefix dispatch)")
+            if misses:
+                self.metrics.counter_inc(
+                    "gen_prefix_misses_total", by=float(misses),
+                    help="request rows that ran the full prefix "
+                         "program")
+            pool_ready = self._state is not None and (
+                d is None or d["mems"] is not None)
+            if not misses and pool_ready:
+                # ALL rows cached: admit straight from the pooled
+                # states — ZERO prefix dispatches; the first token of
+                # this request costs one pool step
+                if obs_trace._armed:
+                    obs_trace.instant(
+                        "gen.prefix_hit", cat="gen",
+                        request_id=req.request_id, rows=req.rows)
+                req.cached = ents
+                return
+            # any miss (or cold pool): the padded batch prefix runs for
+            # every row anyway, so hit rows admit from the FRESH states
+            # and only missing rows are inserted below
         with obs_trace.span("gen.prefix", cat="gen",
                             request_id=req.request_id, rows=req.rows):
             padded, n, _ = self.engine._pad_feed(
@@ -632,13 +1108,91 @@ class ContinuousScheduler:
         req.boots = boots  # [nb, ...] device arrays; rows sliced on admit
         req.pes = pes
         self.dispatches_total += 1
+        if d is not None:
+            # the draft model boots ITS slot state from the same feed
+            with obs_trace.span("gen.prefix", cat="gen",
+                                request_id=req.request_id,
+                                rows=req.rows, draft=True):
+                dfn = self._build_prefix(padded, draft=True)
+                dboots, dpes = dfn(d["params"], padded)
+            dmem_specs = tuple((tuple(b.shape[1:]), np.dtype(b.dtype))
+                               for b in dboots)
+            dpe_specs = tuple((tuple(p.shape[1:]), np.dtype(p.dtype))
+                              for p in dpes)
+            self._ensure_draft_pool(dmem_specs, dpe_specs)
+            req.dboots = dboots
+            req.dpes = dpes
+            self.dispatches_total += 1
+        if self._pcache is not None:
+            for r in range(req.rows):
+                if req.cache_keys[r] not in self._pcache:
+                    self._cache_insert(req, r)
+
+    def _cache_insert(self, req: _GenRequest, row: int) -> None:
+        """Pool one row's prefix state (target + draft) into the device
+        LRU — fp arrays as-is, or int8 payloads + per-tensor scales."""
+        tb = tuple(b[row] for b in req.boots)
+        tp = tuple(p[row] for p in req.pes)
+        d = self._draft
+        db = dp = None
+        if d is not None:
+            db = tuple(b[row] for b in req.dboots)
+            dp = tuple(p[row] for p in req.dpes)
+        if self.prefix_cache_quant == "int8":
+            t_pay = self._q_rows(tb, tp)
+            d_pay = self._q_rows(db, dp) if d is not None else None
+        else:
+            t_pay = (tb, tp)
+            d_pay = (db, dp) if d is not None else None
+        payload = {"t": t_pay, "d": d_pay}
+        nbytes = sum(
+            int(leaf.nbytes)
+            for leaf in self._jax.tree_util.tree_leaves(payload))
+        evicted = self._pcache.put(req.cache_keys[row], payload, nbytes)
+        if evicted:
+            self.metrics.counter_inc(
+                "gen_prefix_cache_evictions_total", by=float(evicted),
+                help="prefix states evicted from the device-resident "
+                     "LRU (byte budget pressure)")
 
     def _admit_row(self, req: _GenRequest, row: int, slot: int) -> None:
         jnp = self._jax.numpy
-        boots = tuple(b[row] for b in req.boots)
-        pes = tuple(p[row] for p in req.pes)
-        self._state = self._pool_admit(
-            self._state, jnp.int32(slot), boots, pes)
+        d = self._draft
+        if req.boots is None:
+            # cache-hit admission: pooled state -> slot through the
+            # SAME jitted dynamic-update a fresh prefix uses (int8
+            # entries dequantize inside the copy)
+            t_pay = req.cached[row]["t"]
+            d_pay = req.cached[row]["d"]
+            if self.prefix_cache_quant == "int8":
+                qb, bs, qp, ps = t_pay
+                self._state = self._pool_admit_q(
+                    self._state, jnp.int32(slot), qb, bs, qp, ps)
+                if d is not None:
+                    qb, bs, qp, ps = d_pay
+                    d["mems"], d["tok"], d["pe"] = d["admit_q"](
+                        d["mems"], d["tok"], d["pe"], jnp.int32(slot),
+                        qb, bs, qp, ps)
+            else:
+                boots, pes = t_pay
+                self._state = self._pool_admit(
+                    self._state, jnp.int32(slot), boots, pes)
+                if d is not None:
+                    dboots, dpes = d_pay
+                    d["mems"], d["tok"], d["pe"] = d["admit"](
+                        d["mems"], d["tok"], d["pe"], jnp.int32(slot),
+                        dboots, dpes)
+        else:
+            boots = tuple(b[row] for b in req.boots)
+            pes = tuple(p[row] for p in req.pes)
+            self._state = self._pool_admit(
+                self._state, jnp.int32(slot), boots, pes)
+            if d is not None:
+                dboots = tuple(b[row] for b in req.dboots)
+                dpes = tuple(p[row] for p in req.dpes)
+                d["mems"], d["tok"], d["pe"] = d["admit"](
+                    d["mems"], d["tok"], d["pe"], jnp.int32(slot),
+                    dboots, dpes)
         self._slot_req[slot] = (req, row)
         self._active[slot] = True
         self.admitted_total += 1
@@ -715,6 +1269,118 @@ class ContinuousScheduler:
             if bool(fin[slot].all()) or t >= self.spec.max_len:
                 self._retire(slot, req, row, t)
 
+    def _spec_round(self) -> None:
+        """ONE speculative round over the pool: draft proposes draft_k
+        tokens per slot (one fused dispatch), the target verifies them
+        all in one `pool_verify` dispatch, then ONE host fence streams
+        every accepted token — up to draft_k tokens per slot for the
+        2-dispatch/1-fence cost plain decoding pays PER TOKEN. Every
+        applied step is an exact pool_step update, so the streamed
+        tokens (and final backtrack) are bit-identical to plain
+        decoding; a fully-rejected draft degrades to exactly one plain
+        step."""
+        jnp = self._jax.numpy
+        armed = obs_trace._armed  # hot per-round path: guard all trace work
+        d = self._draft
+        D = self.draft_k
+        if armed:
+            obs_trace._begin("gen.verify", "gen",
+                             {"round": self.verify_rounds_total,
+                              "active": int(self._active.sum())})
+            obs_trace.counter("gen_active_slots", int(self._active.sum()))
+        t0 = time.monotonic()
+        try:
+            faults.fire("serving.predict", model=self.engine.model_name,
+                        path="generate")
+            active = jnp.asarray(self._active)
+            drafts, hist = d["propose"](
+                d["params"], d["mems"], d["tok"], d["pe"])
+            st, ndm, ndt, adv = self._pool_verify(
+                self._params, active, self._state, drafts, hist,
+                d["mems"], d["tok"])
+            self._state = st
+            d["mems"], d["tok"] = ndm, ndt
+            # ONE host fence for everything the streaming loop reads:
+            # beam-0 trellis row (the exact per-step token stream —
+            # column t is written with the step-t token and never
+            # rewritten), finish mask, step counters, accepted counts
+            ttok0, fin, stp, adv_h = self._jax.device_get(
+                (st.trellis_tok[:, 0, :], st.fin, st.step, adv))
+        except Exception as e:
+            if armed:
+                obs_trace._end()
+            if self.breaker is not None:
+                self.breaker.record_failure()
+            with self._cond:
+                self._abort_inflight_locked(GenerationAborted(
+                    f"speculative verify round failed "
+                    f"({type(e).__name__}: {e}); in-flight requests "
+                    "aborted, slots recovered — retry"))
+            return
+        if armed:
+            obs_trace._end()
+        self._verify_lat.observe(time.monotonic() - t0)
+        n_active = int(self._active.sum())
+        adv_sum = int(adv_h.sum())
+        self.dispatches_total += 2
+        self.syncs_total += 1
+        self.steps_total += D  # the device ran D beam_steps per slot
+        self.verify_rounds_total += 1
+        self._occupancy_steps += adv_sum  # productive slot-steps
+        self._draft_proposed += D * n_active
+        self._draft_accepted += adv_sum
+        self.metrics.counter_inc(
+            "gen_steps_total", by=float(D),
+            help="decode pool steps executed")
+        self.metrics.counter_inc(
+            "gen_verify_rounds_total",
+            help="speculative verify rounds (one draft dispatch + one "
+                 "target verify dispatch each)")
+        self.metrics.counter_inc(
+            "gen_draft_tokens_total", by=float(D * n_active),
+            help="tokens proposed by the draft model")
+        self.metrics.counter_inc(
+            "gen_draft_accepted_total", by=float(adv_sum),
+            help="proposed tokens converted to emitted target tokens "
+                 "(the divergence-correcting target step included)")
+        now = time.monotonic()
+        for slot in range(self.max_slots):
+            if not self._active[slot]:
+                continue
+            req, row = self._slot_req[slot]
+            a = int(adv_h[slot])
+            t_new = int(stp[slot])
+            if req.first_token_at is None and req.deadline <= now:
+                # same contract as _step_once: a late FIRST token is
+                # never streamed
+                self._evict_request(req)
+                self._deadline_fail(req, "deadline exceeded before the "
+                                         "first token (cold pool-step "
+                                         "compile? warm the engine)")
+                continue
+            if a <= 0:
+                continue  # defensive: active slots always advance >= 1
+            if req.first_token_at is None:
+                req.first_token_at = now
+                self._first_tok.observe(now - req.submitted_at)
+                if armed:
+                    obs_trace.instant(
+                        "gen.first_token", cat="gen",
+                        request_id=req.request_id, slot=slot)
+            if req.last_token_at is not None:
+                # the round's tokens arrive as one burst; the interval
+                # histogram records per-ROUND cadence in this mode
+                self._per_tok.observe(now - req.last_token_at)
+            req.last_token_at = now
+            self.tokens_total += a
+            self.metrics.counter_inc(
+                "gen_tokens_total", by=float(a),
+                help="tokens streamed across all generation requests")
+            for t in range(t_new - a, t_new):
+                req.handle._emit_token(row, t, int(ttok0[slot, t]))
+            if bool(fin[slot].all()) or t_new >= self.spec.max_len:
+                self._retire(slot, req, row, t_new)
+
     def _retire(self, slot: int, req: _GenRequest, row: int,
                 t_star: int) -> None:
         """Early-exit compaction: backtrack THIS slot's trellis over its
@@ -787,7 +1453,7 @@ class ContinuousScheduler:
                 if self.steps_total else 0.0)
 
     def stats(self) -> Dict[str, Any]:
-        return {
+        out = {
             "max_slots": self.max_slots,
             "active_slots": int(self._active.sum()),
             "queue_depth": self._aq.depth(),
@@ -801,7 +1467,28 @@ class ContinuousScheduler:
             "compiles": self.compiles,
             "beam_size": self.spec.beam_size,
             "max_len": self.spec.max_len,
+            "prefix_programs": {
+                "entries": len(self._prefix_cache),
+                "cap": self.max_prefix_programs,
+                "evictions": self.prefix_program_evictions,
+            },
         }
+        if self._pcache is not None:
+            pc = self._pcache.stats()
+            pc["quant"] = self.prefix_cache_quant or "fp"
+            out["prefix_cache"] = pc
+        if self._draft is not None:
+            out["speculative"] = {
+                "draft_dir": self._draft["dir"],
+                "draft_k": self.draft_k,
+                "verify_rounds_total": self.verify_rounds_total,
+                "proposed_total": self._draft_proposed,
+                "accepted_total": self._draft_accepted,
+                "accept_rate": round(
+                    self._draft_accepted / self._draft_proposed, 4)
+                if self._draft_proposed else 0.0,
+            }
+        return out
 
 
 def _finalize_slot(parents: np.ndarray, toks: np.ndarray,

@@ -683,6 +683,11 @@ _HOT_PATHS = [
     ("paddle_tpu.trainer", "_scan_one"),
     ("paddle_tpu.data.feeder", "produce"),
     ("paddle_tpu.serving.scheduler", "_step_once"),
+    # serving v3 hot loops: the speculative round (per-round, streams
+    # up to draft_k tokens per slot) and the prefix-cache lookup
+    # (per-admission)
+    ("paddle_tpu.serving.scheduler", "_spec_round"),
+    ("paddle_tpu.serving.prefix_cache", "get"),
 ]
 
 
