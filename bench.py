@@ -81,6 +81,19 @@ and a mid-trace zero-downtime rollout with zero hard client errors
 (benchmarks/fleet_autoscale.json; PERF.md "Autoscaler reaction time").
 Knobs: BENCH_FLEET_SECONDS/SEED/RPS/MAXREP.
 
+BENCH_MODEL=serving_disagg (CPU-safe) measures disaggregated
+prefill/decode serving vs monolithic at EQUAL replica count over a
+seeded, digest-recorded long-prefix/short-decode trace: SimReplicas
+model the exclusive prefix program (a running prefill freezes
+co-located decode token cadence), the disagg scenario splits the same
+sims into prefill/decode classes behind the REAL DisaggDispatcher
+(/prefill → payload handoff → /admit streaming). Asserts disagg wins
+BOTH client-observed first-token p99 AND steady-state decode tok/s,
+zero hard errors / re-prefills, and that the real handoff wire's int8
+packing cuts payload bytes >= 1.7x (benchmarks/serving_disagg.json;
+PERF.md "Disaggregated serving"). Knobs:
+BENCH_DISAGG_SECONDS/SEED/RPS/REPLICAS.
+
 BENCH_MODEL=serving_quant (CPU-safe) measures the low-precision serving
 fast path: post-training int8 quantization (paddle_tpu quant) of a
 saved MLP artifact vs its fp32 original — per-request matmul HBM bytes
@@ -2564,6 +2577,267 @@ def run_fleet_autoscale():
     print(json.dumps(rec))
 
 
+def run_serving_disagg():
+    """BENCH_MODEL=serving_disagg: disaggregated prefill/decode serving
+    (ISSUE 18) vs monolithic serving at EQUAL replica count, over a
+    seeded, digest-recorded trace of long-prefix/short-decode requests.
+
+    Methodology (CPU-safe): replicas are fleetctl.sim.SimReplica, which
+    model the ONE device fact that motivates disaggregation — the
+    prefix program is exclusive on the accelerator, so while a prefill
+    runs, every decode stream co-resident on that replica stops
+    emitting tokens (the real ContinuousScheduler's prefix/pool-step
+    interleave). Per-request work is IDENTICAL in both scenarios (same
+    trace event → same prefill sleep + same decode budget); only
+    placement differs:
+
+      monolithic — N phase-less replicas behind the stock JSQ router;
+                   each /generate runs its prefill then streams its
+                   tokens on ONE replica, so fat prefills freeze
+                   co-located decode cadence (head-of-line blocking).
+      disagg     — the SAME N sims split N/2 prefill + N/2 decode
+                   classes behind the REAL DisaggDispatcher: /prefill
+                   on a prefill replica, opaque payload handoff, then
+                   /admit?stream=1 on a decode replica whose cadence
+                   no prefill can freeze. The handoff pays an extra
+                   HTTP hop per request — the bench shows the hop
+                   costs less than the blocking it removes.
+
+    Metrics per scenario: client-observed FIRST-TOKEN p50/p99 (send →
+    first NDJSON token line) and STEADY-STATE DECODE RATE (total tokens
+    / total first-token→done stream seconds — the inverse of mean
+    inter-token latency, which is what a frozen pool degrades).
+    Asserts disagg beats monolithic on BOTH, with zero hard errors and
+    zero re-prefills, and records pt_handoff_* counters from the
+    dispatcher's registry. A separate section packs a synthetic decode
+    state through the REAL handoff wire format raw vs int8 (asserts
+    int8 cuts payload bytes >= 1.7x). Persists
+    benchmarks/serving_disagg.json. Knobs:
+    BENCH_DISAGG_SECONDS/SEED/RPS/REPLICAS."""
+    import threading
+    import urllib.error
+    import urllib.request
+
+    from paddle_tpu.fleetctl import SimReplica
+    from paddle_tpu.fleetctl.traces import (TraceSpec, generate_trace,
+                                            trace_digest)
+    from paddle_tpu.obs import metrics as obs_metrics
+    from paddle_tpu.obs import promparse
+    from paddle_tpu.serving.disagg import DisaggDispatcher, pack_handoff
+    from paddle_tpu.serving.router import Router, make_router_server
+
+    duration = float(os.environ.get("BENCH_DISAGG_SECONDS", 20.0))
+    seed = int(os.environ.get("BENCH_DISAGG_SEED", 0))
+    base_rps = float(os.environ.get("BENCH_DISAGG_RPS", 30.0))
+    replicas = int(os.environ.get("BENCH_DISAGG_REPLICAS", 4))
+    if replicas < 2 or replicas % 2:
+        raise SystemExit("BENCH_DISAGG_REPLICAS must be even and >= 2 "
+                         "(the disagg scenario splits it N/2 + N/2)")
+    slots = 4
+    token_ms = 6.0  # decode budget per token (sim device time)
+
+    # every request carries the disagg phase split: a lognormal prefill
+    # (mean ~40 ms, p99 ~120 ms) and a short uniform decode budget —
+    # the long-prompt chat regime where prefill/decode interference is
+    # worst. service_ms is drawn but unused (disagg events override it).
+    spec = TraceSpec(
+        duration_s=duration, seed=seed, base_rps=base_rps,
+        diurnal_amplitude=0.2, diurnal_period_s=duration * 0.8,
+        flash_crowds=(), models=(("chat", 1.0, "interactive"),),
+        pareto_alpha=1.6, service_ms_scale=1.0, max_service_ms=5.0,
+        disagg_fraction=1.0, prefill_ms_mu=3.4, prefill_ms_sigma=0.6,
+        max_prefill_ms=400.0, decode_tokens_min=4, decode_tokens_max=12)
+    trace = generate_trace(spec)
+    digest = trace_digest(trace)
+    print(f"trace: {len(trace)} events over {duration:g}s, "
+          f"digest {digest[:16]}", flush=True)
+
+    class Replay:
+        """Open-loop replay; each event is one streamed /generate."""
+
+        def __init__(self, url, disagg):
+            self.url = url
+            self.disagg = disagg
+            self.lock = threading.Lock()
+            # (t_rel, status, first_token_ms, tokens, decode_s)
+            self.results = []
+            self.hard_errors = []
+            self._threads = []
+
+        def _one(self, ev, t0):
+            body = {"stream": True, "tokens": ev["decode_tokens"],
+                    "sim_prefill_ms": ev["prefill_ms"],
+                    "timeout_ms": 30000}
+            # same decode budget either way; the key is WHICH replica
+            # runs it ("sim_ms" drives the monolithic /generate pool,
+            # "sim_decode_ms" rides the handoff payload to /admit)
+            decode_ms = ev["decode_tokens"] * token_ms
+            body["sim_decode_ms" if self.disagg else "sim_ms"] = \
+                decode_ms
+            req = urllib.request.Request(
+                self.url + "/generate", data=json.dumps(body).encode(),
+                headers={"Content-Type": "application/json"})
+            sent = time.perf_counter()
+            status, first, toks = 200, None, 0
+            try:
+                with urllib.request.urlopen(req, timeout=45) as r:
+                    for line in r:
+                        if not line.strip():
+                            continue
+                        evt = json.loads(line)
+                        if evt.get("event") == "token":
+                            toks += 1
+                            if first is None:
+                                first = time.perf_counter()
+                        elif evt.get("event") == "error":
+                            status = -2
+                            with self.lock:
+                                self.hard_errors.append(evt)
+            except urllib.error.HTTPError as e:
+                status = e.code
+                if not (e.code == 503 and e.headers.get("Retry-After")):
+                    with self.lock:
+                        self.hard_errors.append(e.code)
+            except Exception as e:  # noqa: BLE001 - hard failure signal
+                status = -1
+                with self.lock:
+                    self.hard_errors.append(repr(e))
+            done = time.perf_counter()
+            with self.lock:
+                self.results.append((
+                    sent - t0, status,
+                    (first - sent) * 1e3 if first else None,
+                    toks, done - first if first else 0.0))
+
+        def run(self):
+            t0 = time.perf_counter()
+            for ev in trace:
+                delay = ev["t"] - (time.perf_counter() - t0)
+                if delay > 0:
+                    time.sleep(delay)
+                th = threading.Thread(target=self._one, args=(ev, t0),
+                                      daemon=True)
+                th.start()
+                self._threads.append(th)
+            for th in self._threads:
+                th.join(timeout=50)
+
+    def run_scenario(disagg):
+        reg = obs_metrics.MetricsRegistry()
+        router = Router(probe_interval_s=0.05, request_timeout_s=60.0,
+                        registry=reg).start()
+        sims = [SimReplica(slots=slots, max_queue=256,
+                           fingerprint="fp-disagg")
+                for _ in range(replicas)]
+        for i, s in enumerate(sims):
+            phase = (("prefill" if i < replicas // 2 else "decode")
+                     if disagg else None)
+            router.add_replica(s.url, process=s, phase=phase)
+        deadline = time.monotonic() + 30.0
+        while not all(r.up for r in router.replicas()):
+            if time.monotonic() > deadline:
+                raise RuntimeError("sim replicas never probed up")
+            time.sleep(0.02)
+        dispatcher = DisaggDispatcher(router) if disagg else None
+        srv = make_router_server(router, disagg=dispatcher)
+        srv.serve_background()
+        replay = Replay(f"http://127.0.0.1:{srv.port}", disagg)
+        replay.run()
+        scrape = reg.render()
+        srv.shutdown()
+        srv.server_close()
+        router.close()
+        for s in sims:
+            s.kill()
+        ok = [r for r in replay.results if r[1] == 200]
+        firsts = sorted(r[2] for r in ok if r[2] is not None)
+        total_tokens = sum(r[3] for r in ok)
+        decode_s = sum(r[4] for r in ok)
+        fams = promparse.parse_text(scrape)
+
+        def counter(name):
+            f = fams.get(name)
+            return f.samples[0][2] if f is not None and f.samples \
+                else 0.0
+
+        rec = {
+            "requests": len(replay.results),
+            "ok": len(ok),
+            "hard_errors": replay.hard_errors,
+            "first_token_p50_ms":
+                firsts[len(firsts) // 2] if firsts else None,
+            "first_token_p99_ms":
+                firsts[int(len(firsts) * 0.99)] if firsts else None,
+            "tokens": total_tokens,
+            # steady-state decode rate: tokens per second of
+            # first-token→done stream time (inverse mean inter-token
+            # latency) — the figure a frozen pool degrades
+            "steady_tokens_per_s":
+                total_tokens / decode_s if decode_s else 0.0,
+            "handoffs": counter("pt_handoff_total"),
+            "handoff_bytes": counter("pt_handoff_bytes_total"),
+            "reprefills": counter("pt_disagg_reprefills_total"),
+        }
+        return rec
+
+    print(f"scenario 1/2: monolithic ({replicas} replicas x {slots} "
+          "slots) ...", flush=True)
+    mono = run_scenario(disagg=False)
+    print(f"scenario 2/2: disagg ({replicas // 2} prefill + "
+          f"{replicas // 2} decode, same slots) ...", flush=True)
+    dis = run_scenario(disagg=True)
+    for tag, r in (("monolithic", mono), ("disagg", dis)):
+        print(f"  {tag}: ok={r['ok']}/{r['requests']} "
+              f"first_token p50={r['first_token_p50_ms']:.0f}ms "
+              f"p99={r['first_token_p99_ms']:.0f}ms "
+              f"steady={r['steady_tokens_per_s']:.0f} tok/s "
+              f"handoffs={r['handoffs']:.0f}", flush=True)
+
+    # the real handoff wire format, raw vs int8, on a synthetic decode
+    # state shaped like a small LM's boots (4 f32 [rows, hidden] cell
+    # states) + per-example ids/lengths — the ~2x byte cut PERF.md cites
+    rng = np.random.default_rng(0)
+    rows, hidden = 8, 512
+    boots = tuple(rng.standard_normal((rows, hidden)).astype(np.float32)
+                  for _ in range(4))
+    pes = (np.zeros((rows, 32), np.int32),
+           np.full((rows,), 7, np.int32))
+    schema = {"schema_version": 1, "state_fingerprint": "b" * 16}
+    raw = pack_handoff(boots, pes, schema, "bench")
+    q8 = pack_handoff(boots, pes, schema, "bench", quant="int8")
+    wire = {"rows": rows, "hidden": hidden, "float_tensors": len(boots),
+            "raw_bytes": len(raw), "int8_bytes": len(q8),
+            "bytes_ratio": round(len(raw) / len(q8), 3)}
+
+    rec = {
+        "bench": "serving_disagg",
+        "trace": {"digest": digest, "events": len(trace),
+                  "spec": spec.describe()},
+        "replicas": replicas, "slots": slots, "token_ms": token_ms,
+        "monolithic": mono, "disagg": dis,
+        "handoff_wire": wire,
+    }
+    assert mono["hard_errors"] == [], mono["hard_errors"]
+    assert dis["hard_errors"] == [], dis["hard_errors"]
+    assert dis["reprefills"] == 0.0, dis
+    assert dis["handoffs"] == float(dis["ok"]), dis
+    assert dis["first_token_p99_ms"] < mono["first_token_p99_ms"], (
+        "disagg must beat monolithic on first-token p99 at equal "
+        f"replica count: {dis['first_token_p99_ms']:.1f} vs "
+        f"{mono['first_token_p99_ms']:.1f} ms")
+    assert dis["steady_tokens_per_s"] > mono["steady_tokens_per_s"], (
+        "disagg must beat monolithic on steady-state decode rate: "
+        f"{dis['steady_tokens_per_s']:.1f} vs "
+        f"{mono['steady_tokens_per_s']:.1f} tok/s")
+    assert len(q8) * 1.7 < len(raw), wire
+    out_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "benchmarks", "serving_disagg.json")
+    with open(out_path, "w") as f:
+        json.dump(rec, f, indent=1)
+    _attach_calibration(rec, "serving_disagg")
+    print(json.dumps(rec))
+
+
 def _timed_staged_steps(exe, prog, feed, loss, steps):
     """The one staged-timing methodology (warmup, chained async steps,
     final d2h readback) — shared by the headline path and BENCH_OVERLAP
@@ -2608,6 +2882,9 @@ def main():
 
     if model == "fleet_autoscale":
         return run_fleet_autoscale()
+
+    if model == "serving_disagg":
+        return run_serving_disagg()
 
     if model == "tune_search":
         return run_tune_search()

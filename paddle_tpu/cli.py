@@ -64,6 +64,8 @@ Commands:
               [--replicas N [--standby K] [--probe_interval_ms P]
                [--autoscale --min_replicas A --max_replicas B
                 --cooldown_s C]]
+              [--disaggregate --prefill_replicas N --decode_replicas M
+               [--handoff_quant int8]]
               batching HTTP inference server over saved inference
               models (paddle_tpu.serving): /predict, /healthz, /metrics
               — generation models additionally serve /generate
@@ -90,6 +92,14 @@ Commands:
               idle replicas drained + retired, between --min_replicas
               and --max_replicas, with --cooldown_s between actions
               (paddle_tpu.fleetctl.autoscaler; watch /admin/fleet)
+              --disaggregate splits the fleet into N PREFILL replicas
+              (prefix program only) and M DECODE replicas (slot pool):
+              /generate runs the prefix on a prefill replica, ships
+              the decode boot state as a handoff payload (bit-
+              identical admission; --handoff_quant int8 halves the
+              bytes) and streams tokens from a decode replica; with
+              --autoscale each class scales on its own signal
+              (paddle_tpu.serving.disagg)
   fleetctl    rollout --router URL --model_dir D [--model NAME]
               | status --router URL
               control-plane client for a serve --replicas router:
@@ -431,10 +441,15 @@ _SERVE_KNOWN = {
     # promotion under pressure, drain-and-retire when idle
     "autoscale": bool, "min_replicas": str, "max_replicas": str,
     "cooldown_s": str,
+    # disaggregated serving (serving/disagg): phase-specialized
+    # replica classes with device-state handoff
+    "disaggregate": bool, "prefill_replicas": str,
+    "decode_replicas": str, "handoff_quant": str,
 }
 _FLEET_ONLY = ("replicas", "standby", "probe_interval_ms", "host",
                "port", "trace_out", "autoscale", "min_replicas",
-               "max_replicas", "cooldown_s")
+               "max_replicas", "cooldown_s", "disaggregate",
+               "prefill_replicas", "decode_replicas", "handoff_quant")
 
 
 def _cmd_serve(argv) -> int:
@@ -446,7 +461,9 @@ def _cmd_serve(argv) -> int:
     from .serving import BucketPolicy, ModelRegistry, make_server
 
     opts = _parse_kv(argv, _SERVE_KNOWN)
-    if int(opts.get("replicas", 0) or 0) > 0:
+    if (int(opts.get("replicas", 0) or 0) > 0
+            or opts.get("disaggregate", "0")
+            not in ("0", "false", "no", "")):
         return _serve_fleet(opts)
     if opts.get("trace_out"):
         from .obs import trace as obs_trace
@@ -595,13 +612,28 @@ def _serve_fleet(opts) -> int:
             child_args.extend(f"--{k}={x}" for x in v)
         else:
             child_args.append(f"--{k}={v}")
-    n = int(opts["replicas"])
+    disagg_on = (opts.get("disaggregate", "0")
+                 not in ("0", "false", "no", ""))
     standby = int(opts.get("standby", 0))
     router = Router(
         probe_interval_s=float(opts.get("probe_interval_ms", 500)) / 1e3,
         slo_policy=SLOPolicy.from_specs(opts.get("slo", [])))
-    fleet = Fleet(replica_spawner(child_args), replicas=n,
-                  standby=standby, router=router)
+    if disagg_on:
+        # disaggregated topology: two replica classes behind one
+        # router, /generate phase-split through a DisaggDispatcher
+        from .serving.disagg import DisaggFleet
+
+        npf = int(opts.get("prefill_replicas", 1))
+        ndec = int(opts.get("decode_replicas", 1))
+        n = npf + ndec
+        fleet = DisaggFleet(replica_spawner(child_args),
+                            prefill_replicas=npf,
+                            decode_replicas=ndec,
+                            standby=standby, router=router)
+    else:
+        n = int(opts["replicas"])
+        fleet = Fleet(replica_spawner(child_args), replicas=n,
+                      standby=standby, router=router)
 
     # rollout hook: model_dir -> spawn_fn serving THAT artifact with
     # this fleet's serve flags (fleetctl rollout warms the new version
@@ -618,24 +650,42 @@ def _serve_fleet(opts) -> int:
           + " ...", flush=True)
     fleet.start()
     for r in router.replicas():
-        print(f"  replica {r.name}: {r.url}", flush=True)
+        print(f"  replica {r.name}: {r.url}"
+              + (f" [{r.phase}]" if r.phase else ""), flush=True)
     scaler = None
     if opts.get("autoscale", "0") not in ("0", "false", "no", ""):
-        from .fleetctl import Autoscaler, AutoscalerConfig
+        if disagg_on:
+            from .serving.disagg import make_phase_autoscalers
 
-        cfg = AutoscalerConfig(
-            min_replicas=int(opts.get("min_replicas", 1)),
-            max_replicas=int(opts.get("max_replicas", max(n, 1) + max(
-                standby, 1))),
-            cooldown_s=float(opts.get("cooldown_s", 3.0)))
-        scaler = Autoscaler(fleet, cfg).start()
-        print(f"autoscaler armed: {cfg.min_replicas}.."
-              f"{cfg.max_replicas} replicas, "
-              f"cooldown {cfg.cooldown_s:g}s", flush=True)
+            scaler = make_phase_autoscalers(fleet).start()
+            print("phase autoscalers armed: prefill scales on queue "
+                  "age/depth, decode on slot occupancy", flush=True)
+        else:
+            from .fleetctl import Autoscaler, AutoscalerConfig
+
+            cfg = AutoscalerConfig(
+                min_replicas=int(opts.get("min_replicas", 1)),
+                max_replicas=int(opts.get("max_replicas",
+                                          max(n, 1) + max(standby, 1))),
+                cooldown_s=float(opts.get("cooldown_s", 3.0)))
+            scaler = Autoscaler(fleet, cfg).start()
+            print(f"autoscaler armed: {cfg.min_replicas}.."
+                  f"{cfg.max_replicas} replicas, "
+                  f"cooldown {cfg.cooldown_s:g}s", flush=True)
+    dispatcher = None
+    if disagg_on:
+        from .serving.disagg import DisaggDispatcher
+
+        dispatcher = DisaggDispatcher(
+            router, quant=opts.get("handoff_quant") or None)
+        print("disaggregated dispatch armed: /generate phase-splits "
+              "prefill -> handoff -> decode"
+              + (f" (handoff quant {opts['handoff_quant']})"
+                 if opts.get("handoff_quant") else ""), flush=True)
     server = make_router_server(
         router, host=opts.get("host", "127.0.0.1"),
         port=int(opts.get("port", 8866)),
-        fleet=fleet, autoscaler=scaler)
+        fleet=fleet, autoscaler=scaler, disagg=dispatcher)
     server.serve_background()
 
     import signal

@@ -124,11 +124,16 @@ class Autoscaler:
 
     def __init__(self, fleet, config: Optional[AutoscalerConfig] = None,
                  registry: Optional[obs_metrics.MetricsRegistry] = None,
-                 clock=time.monotonic):
+                 clock=time.monotonic, family: str = "pt_autoscale"):
         self.fleet = fleet
         self.cfg = config or AutoscalerConfig()
         self.clock = clock
         self.registry = registry or fleet.router.registry
+        # metric family prefix: a disagg deployment runs TWO loops
+        # (serving/disagg.make_phase_autoscalers), one per replica
+        # class, each under its own family (pt_autoscale_prefill_*,
+        # pt_autoscale_decode_*) so their counters/gauges never collide
+        self.family = family
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
         # decision state
@@ -142,23 +147,23 @@ class Autoscaler:
         # obs surface: pre-declared counters + live gauges so a scrape
         # sees the control loop from construction
         for name, help in (
-            ("pt_autoscale_up_total",
+            (f"{family}_up_total",
              "scale-up actions (warm standbys promoted)"),
-            ("pt_autoscale_down_total",
+            (f"{family}_down_total",
              "scale-down actions (replicas retired)"),
-            ("pt_autoscale_blocked_total",
+            (f"{family}_blocked_total",
              "scale-ups wanted while no warm standby was ready"),
         ):
             self.registry.declare_counter(name, help=help)
         self._reaction_hist = self.registry.histogram(
-            "pt_autoscale_reaction_seconds", buckets=REACTION_BUCKETS,
+            f"{family}_reaction_seconds", buckets=REACTION_BUCKETS,
             help="pressure-first-seen to standby-promoted interval")
         self.registry.gauge(
-            "pt_autoscale_replicas",
+            f"{family}_replicas",
             lambda: float(self.fleet.size()),
             help="replicas currently in the serving rotation")
         self.registry.gauge(
-            "pt_autoscale_pressure",
+            f"{family}_pressure",
             lambda: 1.0 if self.pressure_since is not None else 0.0,
             help="1 while the up-pressure signal is crossed")
 
@@ -247,13 +252,14 @@ class Autoscaler:
                 # wanted a replica, none warmed yet: count it, keep
                 # the streak so the NEXT ready standby is taken
                 # immediately, and don't burn the cooldown
-                self.registry.counter_inc("pt_autoscale_blocked_total")
+                self.registry.counter_inc(
+                    f"{self.family}_blocked_total")
                 return None
             reaction = (now - self.pressure_since
                         if self.pressure_since is not None else 0.0)
             self.last_reaction_s = reaction
             self._reaction_hist.observe(reaction)
-            self.registry.counter_inc("pt_autoscale_up_total")
+            self.registry.counter_inc(f"{self.family}_up_total")
             self._note(now, "up", promoted, sig, reaction)
             self.up_streak = 0
             self.pressure_since = None
@@ -264,7 +270,7 @@ class Autoscaler:
                 1, drain_timeout_s=self.cfg.drain_timeout_s)
             if not retired:
                 return None
-            self.registry.counter_inc("pt_autoscale_down_total")
+            self.registry.counter_inc(f"{self.family}_down_total")
             self._note(now, "down", retired, sig, None)
             self.down_streak = 0
             self.last_action_at = now
@@ -313,10 +319,11 @@ class Autoscaler:
             "config": self.cfg.describe(),
             "replicas": self.fleet.size(),
             "ticks_total": self.ticks_total,
-            "up_total": reg.counter_value("pt_autoscale_up_total"),
-            "down_total": reg.counter_value("pt_autoscale_down_total"),
+            "up_total": reg.counter_value(f"{self.family}_up_total"),
+            "down_total": reg.counter_value(
+                f"{self.family}_down_total"),
             "blocked_total": reg.counter_value(
-                "pt_autoscale_blocked_total"),
+                f"{self.family}_blocked_total"),
             "last_reaction_s": self.last_reaction_s,
             "pressure": self.pressure_since is not None,
             "recent_actions": self.actions[-10:],

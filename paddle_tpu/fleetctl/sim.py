@@ -49,14 +49,15 @@ class _SimRequest:
     """One queued unit of simulated work (AdmissionQueue item)."""
 
     __slots__ = ("slo_class", "deadline", "enqueued_at", "service_s",
-                 "tokens", "events", "done", "error")
+                 "prefill_s", "tokens", "events", "done", "error")
 
     def __init__(self, slo: str, service_s: float, tokens: int,
-                 deadline: float):
+                 deadline: float, prefill_s: float = 0.0):
         self.slo_class = slo
         self.deadline = deadline
         self.enqueued_at = 0.0
         self.service_s = service_s
+        self.prefill_s = prefill_s
         self.tokens = max(1, tokens)
         import queue as _queue
 
@@ -93,9 +94,14 @@ class SimReplica:
         self.aq = AdmissionQueue(max_queue, self._cond, self.metrics,
                                  prefix="sim_")
         self._active = 0
+        self._prefills_running = 0
         self._stopping = False
         self._exited = threading.Event()
         self.requests_total = 0
+        # disagg phase counters (mirror ModelRegistry.load()): which
+        # phase this sim actually served
+        self.prefills_total = 0
+        self.handoffs_admitted_total = 0
         sim = self
 
         class _Handler(BaseHTTPRequestHandler):
@@ -115,6 +121,18 @@ class SimReplica:
                     self._reply(404, {"error": f"no route {self.path!r}"})
 
             def do_POST(self):
+                # disagg phase endpoints: the sim speaks the same
+                # /prefill → opaque payload → /admit wire the real
+                # replica does, so the REAL DisaggDispatcher + phased
+                # Router drive sim fleets in the serving_disagg bench
+                if self.path == "/prefill" \
+                        or self.path.startswith("/prefill/"):
+                    self._prefill()
+                    return
+                if self.path == "/admit" \
+                        or self.path.startswith("/admit/"):
+                    self._admit()
+                    return
                 if not (self.path.startswith("/predict")
                         or self.path.startswith("/generate")):
                     self._reply(404, {"error": f"no route {self.path!r}"})
@@ -140,8 +158,16 @@ class SimReplica:
                 tokens = int(req.get("tokens", 1)) if stream else 1
                 timeout_s = (float(req["timeout_ms"]) / 1e3
                              if "timeout_ms" in req else sim.timeout_s)
+                # monolithic phase split: "sim_prefill_ms" makes the
+                # request run an exclusive prefix before its tokens,
+                # stalling the replica's other decode streams — the
+                # same body a disagg topology splits across /prefill
+                # and /admit instead
                 sreq = _SimRequest(slo, service_s, tokens,
-                                   time.monotonic() + timeout_s)
+                                   time.monotonic() + timeout_s,
+                                   prefill_s=float(
+                                       req.get("sim_prefill_ms", 0.0)
+                                   ) / 1e3)
                 try:
                     sim.aq.put(sreq)
                 except ShedError as e:
@@ -162,6 +188,123 @@ class SimReplica:
                     "model": "default",
                     "fingerprint": sim.fingerprint,
                     "outputs": {"y": [[0.0]]},
+                }, rid=rid)
+
+            def _prefill(self) -> None:
+                """Prefill phase: sleep "sim_prefill_ms" in a slot
+                (compute-bound prefix), then return an opaque handoff
+                payload carrying the decode-side budget."""
+                length = int(self.headers.get("Content-Length", 0))
+                try:
+                    req = json.loads(self.rfile.read(length) or b"{}")
+                except ValueError as e:
+                    self._reply(400, {"error": f"bad request: {e}"})
+                    return
+                rid = self.headers.get(REQUEST_ID_HEADER) or "sim-pf"
+                try:
+                    slo = resolve_class(
+                        INTERACTIVE,
+                        self.headers.get(SLO_HEADER) or req.get("slo"))
+                except ValueError as e:
+                    self._reply(400, {"error": str(e)})
+                    return
+                service_s = float(
+                    req.get("sim_prefill_ms",
+                            req.get("sim_ms", sim.service_s * 1e3))) / 1e3
+                timeout_s = (float(req["timeout_ms"]) / 1e3
+                             if "timeout_ms" in req else sim.timeout_s)
+                sreq = _SimRequest(slo, 0.0, 1,
+                                   time.monotonic() + timeout_s,
+                                   prefill_s=service_s)
+                try:
+                    sim.aq.put(sreq)
+                except ShedError as e:
+                    self._reply(503, {"error": str(e)}, retry_after=True)
+                    return
+                sreq.done.wait(timeout=timeout_s + max(1.0, timeout_s))
+                if sreq.error is not None:
+                    code = 503 if isinstance(sreq.error, ShedError) \
+                        else 504
+                    self._reply(code, {"error": str(sreq.error)},
+                                retry_after=(code == 503))
+                    return
+                sim.prefills_total += 1
+                payload = b"SIMHO" + json.dumps({
+                    "decode_ms": float(
+                        req.get("sim_decode_ms",
+                                req.get("sim_ms", sim.service_s * 1e3))),
+                    "tokens": int(req.get("tokens", 1)),
+                    "fingerprint": sim.fingerprint,
+                }, sort_keys=True).encode()
+                self._reply(200, payload,
+                            ctype="application/octet-stream", rid=rid)
+
+            def _admit(self) -> None:
+                """Decode phase: admit a shipped payload, run its
+                decode budget through the slot pool (stream option in
+                the query string — the body is opaque bytes)."""
+                from urllib.parse import parse_qs, urlparse
+
+                u = urlparse(self.path)
+                opts = {k: v[-1]
+                        for k, v in parse_qs(u.query).items()}
+                length = int(self.headers.get("Content-Length", 0))
+                payload = self.rfile.read(length)
+                rid = self.headers.get(REQUEST_ID_HEADER) or "sim-adm"
+                if not payload.startswith(b"SIMHO"):
+                    self._reply(400, {"error": "not a sim handoff "
+                                               "payload (bad magic)"})
+                    return
+                try:
+                    hdr = json.loads(payload[5:].decode())
+                except ValueError as e:
+                    self._reply(400, {"error": f"bad payload: {e}"})
+                    return
+                if hdr.get("fingerprint") != sim.fingerprint:
+                    # mixed-version fleet: same 409 contract as the
+                    # real replica's HandoffSchemaError
+                    self._reply(409, {
+                        "error": "handoff fingerprint "
+                                 f"{hdr.get('fingerprint')} != this "
+                                 f"replica's {sim.fingerprint}: roll "
+                                 "the fleet to one artifact "
+                                 "(paddle_tpu fleetctl rollout)",
+                        "kind": "HandoffSchemaError"})
+                    return
+                try:
+                    slo = resolve_class(INTERACTIVE,
+                                        self.headers.get(SLO_HEADER))
+                except ValueError as e:
+                    self._reply(400, {"error": str(e)})
+                    return
+                stream = opts.get("stream") in ("1", "true")
+                timeout_s = (float(opts["timeout_ms"]) / 1e3
+                             if "timeout_ms" in opts else sim.timeout_s)
+                tokens = int(hdr.get("tokens", 1))
+                decode_s = float(hdr.get("decode_ms",
+                                         sim.service_s * 1e3)) / 1e3
+                sreq = _SimRequest(slo, decode_s, tokens,
+                                   time.monotonic() + timeout_s)
+                try:
+                    sim.aq.put(sreq)
+                except ShedError as e:
+                    self._reply(503, {"error": str(e)}, retry_after=True)
+                    return
+                sim.handoffs_admitted_total += 1
+                if stream:
+                    self._stream(sreq, rid)
+                    return
+                sreq.done.wait(timeout=timeout_s + max(1.0, timeout_s))
+                if sreq.error is not None:
+                    code = 503 if isinstance(sreq.error, ShedError) \
+                        else 504
+                    self._reply(code, {"error": str(sreq.error)},
+                                retry_after=(code == 503))
+                    return
+                self._reply(200, {
+                    "model": "default",
+                    "fingerprint": sim.fingerprint,
+                    "outputs": {"ids": [[tokens]]},
                 }, rid=rid)
 
             def _stream(self, sreq: "_SimRequest", rid: str) -> None:
@@ -241,8 +384,28 @@ class SimReplica:
                     return
                 self._active += 1
             try:
+                if req.prefill_s > 0.0:
+                    # prefix compute is EXCLUSIVE on the device: while
+                    # it runs, every decode stream on this replica
+                    # stalls (the real scheduler's pool step and the
+                    # prefix program share the accelerator, so a fat
+                    # prefill freezes token emission for the whole
+                    # pool). A disagg decode replica never runs a
+                    # prefill, so its cadence is never frozen — the
+                    # head-of-line effect the serving_disagg bench
+                    # measures.
+                    with self._cond:
+                        self._prefills_running += 1
+                    try:
+                        time.sleep(req.prefill_s)
+                    finally:
+                        with self._cond:
+                            self._prefills_running -= 1
+                            self._cond.notify_all()
                 per_token = req.service_s / req.tokens
                 for t in range(req.tokens):
+                    if req.service_s > 0.0:
+                        self._stall_for_prefill()
                     time.sleep(per_token)
                     req.events.put(("token", t))
                 req.events.put(("done", req.tokens))
@@ -251,6 +414,13 @@ class SimReplica:
             finally:
                 with self._cond:
                     self._active -= 1
+
+    def _stall_for_prefill(self) -> None:
+        """Pause decode-token emission while any prefix program runs
+        on this replica's device (see the worker comment)."""
+        with self._cond:
+            while self._prefills_running and not self._stopping:
+                self._cond.wait(timeout=0.005)
 
     # -- wire surface ---------------------------------------------------
     def healthz(self) -> Dict[str, Any]:
@@ -264,10 +434,13 @@ class SimReplica:
             "queue_age_ms": age_ms,
             "active_slots": self._active,
             "max_slots": self.slots,
+            "free_slots": max(0, self.slots - self._active),
             "slot_occupancy": self._active / self.slots,
             "first_token_p99_ms": 0.0,
             "dispatches_total": self.requests_total,
             "syncs_total": self.requests_total,
+            "prefills_total": self.prefills_total,
+            "handoffs_admitted_total": self.handoffs_admitted_total,
             "classes": classes,
             "models": {
                 m: {"queue_depth": depth, "queue_age_ms": age_ms,

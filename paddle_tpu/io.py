@@ -41,6 +41,8 @@ from .resilience import faults
 __all__ = [
     "CheckpointCorruptError",
     "QuantMetaError",
+    "GENERATION_SCHEMA_VERSION",
+    "generation_state_fingerprint",
     "program_fingerprint",
     "quant_scales_digest",
     "save_vars",
@@ -65,6 +67,40 @@ PARAMS_FILE = "params.npz"
 PROGRAM_FILE = "program.json"
 META_FILE = "meta.json"
 CHECKPOINT_PREFIX = "checkpoint"
+
+# DecodeState wire-schema version: bump when the serialized decode-state
+# layout (what generation_state_fingerprint hashes, or how disagg
+# handoff payloads interpret it) changes incompatibly. Prefill/decode
+# replicas exchange device state across processes, so the schema is part
+# of the artifact's identity, not an implementation detail.
+GENERATION_SCHEMA_VERSION = 1
+
+
+def generation_state_fingerprint(gen: Dict[str, Any]) -> str:
+    """Layout identity of the decode state a generation artifact boots:
+    beam geometry + per-state/per-example dtypes and trailing shapes,
+    hashed over canonical JSON. Two artifacts with equal fingerprints
+    allocate bit-compatible DecodeState pools, so a prefill replica's
+    handoff payload can be admitted by a decode replica iff the
+    fingerprints match (serving/disagg validates exactly this).
+    Deliberately EXCLUDES the program fingerprint: a retrained model
+    with unchanged state geometry still hands off cleanly mid-rollout —
+    only layout breaks are rejected."""
+    layout = {
+        "schema_version": int(gen.get("schema_version",
+                                      GENERATION_SCHEMA_VERSION)),
+        "beam_size": int(gen["beam_size"]),
+        "max_len": int(gen["max_len"]),
+        "bos_id": int(gen["bos_id"]),
+        "eos_id": int(gen["eos_id"]),
+        "length_normalize": bool(gen.get("length_normalize", False)),
+        "state": [[s["name"], s["dtype"], s["shape"]]
+                  for s in gen.get("state", [])],
+        "per_example": [[s["name"], s["dtype"], s["shape"]]
+                        for s in gen.get("per_example", [])],
+    }
+    blob = json.dumps(layout, sort_keys=True, separators=(",", ":"))
+    return hashlib.sha256(blob.encode()).hexdigest()[:16]
 
 
 class CheckpointCorruptError(RuntimeError):
@@ -475,7 +511,7 @@ def _generation_meta(pruned: Program) -> Optional[dict]:
                 "shape": trailing if all(d > 0 for d in trailing)
                 else None}
 
-    return {
+    gen = {
         "beam_size": int(op.attrs.get("beam_size", 4)),
         "max_len": int(op.attrs.get("max_len", 32)),
         "bos_id": int(op.attrs.get("bos_id", 0)),
@@ -489,6 +525,11 @@ def _generation_meta(pruned: Program) -> Optional[dict]:
             "lengths": op.outputs["Lengths"][0],
         },
     }
+    # the DecodeState wire-schema identity travels with the artifact so
+    # a disagg handoff can be validated BEFORE any state touches a pool
+    gen["schema_version"] = GENERATION_SCHEMA_VERSION
+    gen["state_fingerprint"] = generation_state_fingerprint(gen)
+    return gen
 
 
 def load_inference_model(dirname: str, scope: Optional[Scope] = None):
@@ -515,6 +556,14 @@ def load_inference_model(dirname: str, scope: Optional[Scope] = None):
     # artifacts): beam geometry + decode-state specs, consumed by
     # serving.scheduler.ContinuousScheduler warmup
     program._generation_meta = meta.get("generation") or None
+    # pre-disagg artifacts lack the DecodeState schema identity: backfill
+    # it from the state specs already in the sidecar, so handoff
+    # validation has a fingerprint to compare for every artifact age
+    if program._generation_meta is not None \
+            and not program._generation_meta.get("state_fingerprint"):
+        g = program._generation_meta
+        g.setdefault("schema_version", GENERATION_SCHEMA_VERSION)
+        g["state_fingerprint"] = generation_state_fingerprint(g)
     # draft-model sidecar (absent unless exported with draft_model=...):
     # the speculative-decoding companion dir, consumed by the serving
     # scheduler (relative paths resolve against the artifact dir)

@@ -171,6 +171,41 @@ def reshard_scope_to_mesh(
     return n
 
 
+def gather_handoff_rows(arrays, rows: int):
+    """Device→host gather of the first ROWS rows of each array in a
+    prefix-state tuple — the serving sibling of the checkpoint path
+    above: state saved on one world (the prefill replica's mp/dp mesh)
+    travels as plain host arrays, exactly like `sharded_meta.json`
+    restores, so the admitting world never needs to know the saving
+    mesh. One jax.device_get moves the whole tuple (a single d2h fence
+    for the handoff, mirroring the scheduler's one-fence step loop);
+    mesh-sharded prefix outputs all-gather here, which IS the reshard:
+    the decode replica re-places from host onto its own devices."""
+    import jax
+
+    host = jax.device_get(tuple(arrays))
+    return tuple(np.asarray(a)[:rows] for a in host)
+
+
+def restore_handoff_rows(arrays, mesh=None, batch_axis: str = "dp"):
+    """Host→device placement of handoff state rows onto the ADMITTING
+    world — `reshard_scope_to_mesh` for a prefix-state tuple instead of
+    a program scope. With a mesh, rows are replicated across it (the
+    decode pool is slot-indexed, not batch-sharded — the pool_admit
+    dynamic-update owns distribution); without one, a plain device_put.
+    A cross-world restore is observable via the same counter the
+    checkpoint path increments."""
+    import jax
+
+    if mesh is None:
+        return tuple(jax.device_put(np.asarray(a)) for a in arrays)
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    count_reshard()
+    sharding = NamedSharding(mesh, PartitionSpec())
+    return tuple(jax.device_put(np.asarray(a), sharding) for a in arrays)
+
+
 def load_checkpoint_resharded(
     checkpoint_dir: str,
     main_program: Optional[Program] = None,

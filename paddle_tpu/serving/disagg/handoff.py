@@ -1,0 +1,237 @@
+"""Handoff payload: a request's decode boot state on the wire.
+
+The payload is the send/recv edge of the disaggregated topology — what
+the DistributeTranspiler's send/recv ops are to trainer/pserver, this
+format is to prefill/decode. A prefill replica serializes the HOST-side
+boot state tuples that `ContinuousScheduler.prefill` gathered (one d2h
+fence, mesh outputs already all-gathered), the dispatcher ships the
+bytes, and a decode replica validates + unpacks them into
+`submit_handoff`, which re-places rows onto its own devices. The state
+never round-trips through a re-run of the prefix program, so monolithic
+bit-identity holds by construction.
+
+Layout: `b"PTHO1" | u32 header_len | header JSON | raw buffers`, buffers
+concatenated in header order (boots then per-example rows, each
+optionally followed by its per-row scale vector). The header carries the
+artifact's DecodeState schema identity (io.generation_state_fingerprint)
+so a mixed-version fleet mid-rollout fails at the /admit boundary with a
+typed error naming the fix — never as a shape crash inside the pool.
+
+int8 packing reuses the quant/ per-tensor-symmetric recipe at per-ROW
+granularity, exactly the scheduler's `q_rows` arithmetic (absmax/127
+scale, round + clip; dequant is `q * scale` in f32 then cast): transfer
+bytes drop ~2x for float32 state (4x per float tensor, minus the scale
+vector and any raw-shipped integer state). Non-float state tensors ride
+raw — quantizing token ids would corrupt them, and they are small.
+"""
+
+from __future__ import annotations
+
+import json
+import struct
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import numpy as np
+
+__all__ = ["HandoffError", "HandoffSchemaError", "MAGIC", "pack_handoff",
+           "payload_schema", "unpack_handoff", "validate_handoff"]
+
+MAGIC = b"PTHO1"
+_LEN = struct.Struct(">I")
+
+# one-command fix named by every schema rejection: roll the whole fleet
+# to a single artifact version (warm + verify + flip + drain)
+_ROLLOUT_CMD = ("paddle_tpu fleetctl rollout --router <url> "
+                "--model_dir <new artifact>")
+
+
+class HandoffError(ValueError):
+    """A handoff payload is malformed (bad magic, truncated buffers,
+    unknown quant mode) — the bytes themselves are unusable."""
+
+
+class HandoffSchemaError(HandoffError):
+    """The payload is well-formed but its DecodeState schema identity
+    does not match the admitting artifact: the prefill and decode
+    replicas are serving different decode-state layouts (mixed-version
+    fleet mid-rollout). Rejected at the /admit boundary — before any
+    state touches the pool — with the fix in the message."""
+
+
+def payload_schema(gen_meta: Dict[str, Any]) -> Dict[str, Any]:
+    """The schema identity block a replica stamps on payloads it emits
+    and checks on payloads it admits, from the artifact's generation
+    sidecar (io.load_inference_model backfills the fingerprint for
+    pre-disagg artifacts, so this never returns an empty identity)."""
+    from ... import io as pt_io
+
+    if not gen_meta:
+        raise HandoffError(
+            "model has no generation sidecar — disagg handoff serves "
+            "generation models only")
+    return {
+        "schema_version": int(gen_meta.get(
+            "schema_version", pt_io.GENERATION_SCHEMA_VERSION)),
+        "state_fingerprint": (
+            gen_meta.get("state_fingerprint")
+            or pt_io.generation_state_fingerprint(gen_meta)),
+    }
+
+
+def _dtype(name: str) -> np.dtype:
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes  # registered by jax; bfloat16 et al.
+
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def _quantizable(a: np.ndarray) -> bool:
+    k = np.dtype(a.dtype).kind
+    return k == "f" or np.dtype(a.dtype).name in (
+        "bfloat16", "float8_e4m3fn", "float8_e5m2")
+
+
+def _pack_group(arrays: Sequence[np.ndarray], quant: Optional[str],
+                specs: list, chunks: list) -> None:
+    from ...ops.quant_kernels import INT8_MAX
+
+    for a in arrays:
+        a = np.ascontiguousarray(a)
+        spec = {"dtype": np.dtype(a.dtype).name,
+                "shape": [int(d) for d in a.shape]}
+        if quant == "int8" and _quantizable(a):
+            n = a.shape[0]
+            xf = a.astype(np.float32)
+            absmax = np.max(np.abs(xf.reshape(n, -1)), axis=1) \
+                if a.size else np.zeros((n,), np.float32)
+            # the scheduler q_rows recipe, per ROW: absmax/127 scale,
+            # round + clip (np.round is round-half-even, same as jnp)
+            scale = (np.maximum(absmax, 1e-30) / INT8_MAX).astype(
+                np.float32)
+            q = np.clip(
+                np.round(xf / scale.reshape((n,) + (1,) * (a.ndim - 1))),
+                -INT8_MAX, INT8_MAX).astype(np.int8)
+            spec["q"] = True
+            chunks.append(q.tobytes())
+            chunks.append(np.ascontiguousarray(scale).tobytes())
+        else:
+            spec["q"] = False
+            chunks.append(a.tobytes())
+        specs.append(spec)
+
+
+def pack_handoff(boots: Sequence[np.ndarray], pes: Sequence[np.ndarray],
+                 schema: Dict[str, Any], model: str,
+                 request_id: Optional[str] = None,
+                 quant: Optional[str] = None) -> bytes:
+    """Serialize one request's boot state (host arrays [n, ...]) into a
+    self-describing payload. `schema` is payload_schema(...) of the
+    EMITTING artifact; `quant="int8"` packs float tensors per-row
+    symmetric int8 (+f32 scale vector each)."""
+    if quant not in (None, "int8"):
+        raise HandoffError(
+            f"unsupported handoff quant {quant!r} (only 'int8')")
+    boots, pes = tuple(boots), tuple(pes)
+    rows = {int(a.shape[0]) for a in boots + pes}
+    if len(rows) != 1:
+        raise HandoffError(
+            f"handoff state arrays must share the row axis; got row "
+            f"counts {sorted(rows)}")
+    specs_b: list = []
+    specs_p: list = []
+    chunks: list = []
+    _pack_group(boots, quant, specs_b, chunks)
+    _pack_group(pes, quant, specs_p, chunks)
+    header = {
+        "version": 1,
+        "model": model,
+        "request_id": request_id,
+        "rows": rows.pop(),
+        "quant": quant,
+        "schema_version": int(schema["schema_version"]),
+        "state_fingerprint": str(schema["state_fingerprint"]),
+        "boots": specs_b,
+        "pes": specs_p,
+    }
+    hdr = json.dumps(header, sort_keys=True,
+                     separators=(",", ":")).encode()
+    return b"".join([MAGIC, _LEN.pack(len(hdr)), hdr] + chunks)
+
+
+def _unpack_group(specs: list, data: bytes, off: int):
+    out = []
+    for spec in specs:
+        dt = _dtype(spec["dtype"])
+        shape = tuple(spec["shape"])
+        if spec.get("q"):
+            n = int(np.prod(shape, dtype=np.int64))
+            q = np.frombuffer(data, np.int8, count=n, offset=off)
+            off += n
+            rows = shape[0] if shape else 0
+            scale = np.frombuffer(data, np.float32, count=rows,
+                                  offset=off)
+            off += scale.nbytes
+            q = q.reshape(shape)
+            sc = scale.reshape((rows,) + (1,) * (len(shape) - 1))
+            # dequant mirrors pool_admit_q: q*scale in f32, then cast
+            out.append((q.astype(np.float32) * sc).astype(dt))
+        else:
+            n = int(np.prod(shape, dtype=np.int64))
+            a = np.frombuffer(data, dt, count=n, offset=off)
+            off += a.nbytes
+            out.append(a.reshape(shape))
+    return tuple(out), off
+
+
+def unpack_handoff(data: bytes) -> Tuple[Dict[str, Any], tuple, tuple]:
+    """Parse a payload into (header, boots, pes) host arrays, int8
+    tensors already dequantized. Raises HandoffError on malformed
+    bytes; schema acceptance is the caller's validate_handoff call."""
+    if not data.startswith(MAGIC):
+        raise HandoffError(
+            "not a handoff payload (bad magic) — /admit takes the bytes "
+            "a /prefill call returned, verbatim")
+    try:
+        (hlen,) = _LEN.unpack_from(data, len(MAGIC))
+        off = len(MAGIC) + _LEN.size
+        header = json.loads(data[off:off + hlen].decode())
+        off += hlen
+        boots, off = _unpack_group(header["boots"], data, off)
+        pes, off = _unpack_group(header["pes"], data, off)
+    except HandoffError:
+        raise
+    except Exception as e:
+        raise HandoffError(
+            f"truncated or corrupt handoff payload "
+            f"({type(e).__name__}: {e})") from e
+    if off != len(data):
+        raise HandoffError(
+            f"handoff payload has {len(data) - off} trailing bytes — "
+            "truncated header or mismatched buffer specs")
+    return header, boots, pes
+
+
+def validate_handoff(header: Dict[str, Any],
+                     gen_meta: Dict[str, Any]) -> None:
+    """Admission gate: the payload's DecodeState schema identity must
+    match the ADMITTING artifact's. Runs before any array is even
+    unpacked into the pool, so a mixed-version fleet fails loudly with
+    the one-command fix instead of a shape crash mid-pool."""
+    want = payload_schema(gen_meta)
+    got_v = header.get("schema_version")
+    got_fp = header.get("state_fingerprint")
+    if got_v != want["schema_version"]:
+        raise HandoffSchemaError(
+            f"handoff schema version {got_v} != this replica's "
+            f"{want['schema_version']}: prefill and decode replicas "
+            f"disagree on the DecodeState wire format — roll the whole "
+            f"fleet to one version: {_ROLLOUT_CMD}")
+    if got_fp != want["state_fingerprint"]:
+        raise HandoffSchemaError(
+            f"handoff state fingerprint {got_fp} != this replica's "
+            f"{want['state_fingerprint']}: the prefill replica serves a "
+            f"different decode-state layout (mixed artifact versions "
+            f"mid-rollout?) — roll the whole fleet to one artifact: "
+            f"{_ROLLOUT_CMD}")

@@ -26,7 +26,7 @@ from ..obs.metrics import (DEFAULT_LATENCY_BUCKETS, Histogram,  # noqa: F401
 
 __all__ = ["Histogram", "MetricSet", "DEFAULT_LATENCY_BUCKETS",
            "FIRST_TOKEN_BUCKETS", "TOKEN_INTERVAL_BUCKETS",
-           "VERIFY_ROUND_BUCKETS"]
+           "VERIFY_ROUND_BUCKETS", "HANDOFF_BUCKETS"]
 
 # generation-serving latency grids (continuous batching): first-token
 # latency is queue wait + prefix run + one pool step (ms to seconds —
@@ -46,6 +46,14 @@ TOKEN_INTERVAL_BUCKETS = (
 VERIFY_ROUND_BUCKETS = (
     0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
     1.0, 2.5,
+)
+# disagg handoff transfer (prefill completion → decode admission):
+# payload serialize + one router hop + schema validate + admit
+# enqueue. Loopback sub-ms; cross-host fp32 big-beam state reaches
+# seconds, which is exactly what --handoff_quant int8 halves.
+HANDOFF_BUCKETS = (
+    0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+    0.5, 1.0, 2.5, 5.0,
 )
 
 

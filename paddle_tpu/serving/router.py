@@ -102,8 +102,13 @@ class ReplicaClient:
 
     def __init__(self, name: str, url: str,
                  breaker: Optional[CircuitBreaker] = None,
-                 process: Optional["ReplicaProcess"] = None):
+                 process: Optional["ReplicaProcess"] = None,
+                 phase: Optional[str] = None):
+        if phase not in (None, "prefill", "decode"):
+            raise ValueError(f"replica phase must be None, 'prefill' or "
+                             f"'decode', got {phase!r}")
         self.name = name
+        self.phase = phase     # disagg replica class (None = monolithic)
         self.url = url.rstrip("/")
         m = re.match(r"https?://([^/:]+):(\d+)", self.url)
         if not m:
@@ -132,7 +137,14 @@ class ReplicaClient:
         queue depth is scored instead of the total — a replica whose
         backlog is all batch work still looks short to interactive
         traffic (the batch tier sheds for it on admission). Lower =
-        less loaded. Pure reads — no I/O, no locks."""
+        less loaded. Pure reads — no I/O, no locks.
+
+        Phase-classed replicas (serving/disagg) score on their OWN
+        phase's signal: a prefill replica on queue depth + compute
+        backlog (queue age — its decode pool never fills, so slots are
+        meaningless), a decode replica on how few FREE slots remain
+        (the shipped request is about to occupy one; in-flight covers
+        the handoff window before a probe refreshes the snapshot)."""
         snap = self.snapshot
         depth: Optional[float] = None
         if slo is not None:
@@ -141,6 +153,14 @@ class ReplicaClient:
                 depth = float(classes[slo])
         if depth is None:
             depth = float(snap.get("queue_depth", 0))
+        if self.phase == "prefill":
+            return (2.0 * self.inflight
+                    + depth
+                    + 0.001 * float(snap.get("queue_age_ms", 0.0)))
+        if self.phase == "decode":
+            free = max(0.0, float(snap.get("max_slots", 0))
+                       - float(snap.get("active_slots", 0)))
+            return 2.0 * self.inflight + depth - free
         return (2.0 * self.inflight
                 + depth
                 + float(snap.get("active_slots", 0)))
@@ -149,6 +169,7 @@ class ReplicaClient:
         return {
             "url": self.url,
             "up": self.up,
+            "phase": self.phase,
             "draining": self.draining,
             "breaker": self.breaker.state(),
             "inflight": self.inflight,
@@ -241,7 +262,8 @@ class Router:
     # -- fleet membership ----------------------------------------------
     def _add_locked(self, url: str, name: Optional[str],
                     process: Optional["ReplicaProcess"],
-                    breaker: Optional[CircuitBreaker]) -> ReplicaClient:
+                    breaker: Optional[CircuitBreaker],
+                    phase: Optional[str] = None) -> ReplicaClient:
         """Create + register one client. Caller holds self._lock."""
         if name is None:
             name = f"r{self._next_name}"
@@ -250,7 +272,8 @@ class Router:
             raise ValueError(f"replica {name!r} already registered")
         if breaker is None and self._breaker_kw:
             breaker = CircuitBreaker(**self._breaker_kw)
-        r = ReplicaClient(name, url, process=process, breaker=breaker)
+        r = ReplicaClient(name, url, process=process, breaker=breaker,
+                          phase=phase)
         self._replicas[name] = r
         return r
 
@@ -269,10 +292,11 @@ class Router:
 
     def add_replica(self, url: str, name: Optional[str] = None,
                     process: Optional["ReplicaProcess"] = None,
-                    breaker: Optional[CircuitBreaker] = None
+                    breaker: Optional[CircuitBreaker] = None,
+                    phase: Optional[str] = None
                     ) -> ReplicaClient:
         with self._lock:
-            r = self._add_locked(url, name, process, breaker)
+            r = self._add_locked(url, name, process, breaker, phase)
         self._declare_replica_counters(r.name)
         self._probe_now()
         return r
@@ -336,16 +360,19 @@ class Router:
 
     # -- the pick hot path (NO blocking I/O — AST-linted) ---------------
     def pick(self, exclude: Sequence[str] = (),
-             slo: Optional[str] = None) -> Optional[ReplicaClient]:
+             slo: Optional[str] = None,
+             phase: Optional[str] = None) -> Optional[ReplicaClient]:
         """Join-shortest-queue over admitted replicas: lowest score()
         wins, ties go to the least-recently-picked (round-robin under
         uniform load instead of herding onto one replica). With `slo`
         given, replicas are scored by that class's own queue depth
         (per-class JSQ — batch backlog doesn't repel interactive
-        traffic). Draining replicas (rollout/scale-down) are never
-        picked. Reads ONLY router-local state — breaker admission,
-        in-flight counters and the probe loop's cached snapshots;
-        never the network."""
+        traffic). With `phase` given, only replicas of that disagg
+        class compete (each class scores on its own signal — see
+        ReplicaClient.score). Draining replicas (rollout/scale-down)
+        are never picked. Reads ONLY router-local state — breaker
+        admission, in-flight counters and the probe loop's cached
+        snapshots; never the network."""
         with self._lock:
             # scan with would_admit() (non-consuming) so a HALF_OPEN
             # replica that loses the JSQ comparison keeps its probe
@@ -354,6 +381,7 @@ class Router:
                 ((r.score(slo), r.last_picked, r)
                  for r in self._replicas.values()
                  if r.name not in exclude and not r.draining
+                 and (phase is None or r.phase == phase)
                  and r.breaker.would_admit()),
                 key=lambda t: t[:2])
             for _, _, best in ranked:
@@ -373,14 +401,19 @@ class Router:
     def dispatch(self, path: str, body: bytes,
                  request_id: Optional[str] = None,
                  headers: Optional[Dict[str, str]] = None,
-                 slo: Optional[str] = None) -> _Lease:
+                 slo: Optional[str] = None,
+                 phase: Optional[str] = None,
+                 exclude: Sequence[str] = ()) -> _Lease:
         """POST `body` to the best replica; returns a _Lease whose
         response is either buffered (`lease.body`) or streaming
         (`lease.resp` — chunked NDJSON, relay then `close()`).
 
         `slo` drives the per-class pick and is forwarded in
         X-PT-SLO-Class so the replica's admission tiers agree with the
-        score the pick used.
+        score the pick used. `phase` restricts the pick to one disagg
+        replica class; `exclude` pre-blacklists replica names (the
+        disagg dispatcher's re-prefill avoids the replica whose
+        payload just failed).
 
         Failover contract: a 503 (replica shed / its model breaker)
         and any transport error move on to the next-best replica the
@@ -391,10 +424,10 @@ class Router:
         if slo is not None:
             headers = dict(headers or {})
             headers[SLO_HEADER] = slo
-        tried: List[str] = []
+        tried: List[str] = list(exclude)
         last_shed: Optional[_Lease] = None
         while True:
-            replica = self.pick(exclude=tried, slo=slo)
+            replica = self.pick(exclude=tried, slo=slo, phase=phase)
             if replica is None:
                 if last_shed is not None:
                     # every admitted replica shed: relay the final 503
@@ -582,7 +615,7 @@ class Router:
             slots.append((lb, float(r.snapshot.get("active_slots", 0))))
             inflight.append((lb, float(r.inflight)))
             draining.append((lb, 1.0 if r.draining else 0.0))
-        return [
+        fams = [
             ("pt_replica_up", "gauge",
              "1 while the replica's last health probe succeeded", up),
             ("pt_replica_breaker_state", "gauge",
@@ -600,6 +633,43 @@ class Router:
              "1 while the replica is retiring (rollout/scale-down): "
              "finishing in-flight work, excluded from picks", draining),
         ]
+        # disagg: per-phase breakdown of the same signals, one series
+        # per replica CLASS ({phase=prefill|decode}) so dashboards see
+        # the two classes' load separately without relabeling the
+        # per-replica families above
+        agg: Dict[str, Dict[str, float]] = {}
+        for r in reps:
+            if r.phase is None:
+                continue
+            a = agg.setdefault(r.phase, {"queue_depth": 0.0,
+                                         "inflight": 0.0,
+                                         "free_slots": 0.0,
+                                         "replicas": 0.0})
+            a["replicas"] += 1.0
+            a["queue_depth"] += float(r.snapshot.get("queue_depth", 0))
+            a["inflight"] += float(r.inflight)
+            a["free_slots"] += max(
+                0.0, float(r.snapshot.get("max_slots", 0))
+                - float(r.snapshot.get("active_slots", 0)))
+        if agg:
+            def _series(key):
+                return [({"phase": p}, v[key])
+                        for p, v in sorted(agg.items())]
+            fams.extend([
+                ("pt_phase_replicas", "gauge",
+                 "replicas registered in this disagg phase class",
+                 _series("replicas")),
+                ("pt_phase_queue_depth", "gauge",
+                 "admission-queue depth summed over one phase class",
+                 _series("queue_depth")),
+                ("pt_phase_inflight", "gauge",
+                 "router-tracked in-flight requests summed over one "
+                 "phase class", _series("inflight")),
+                ("pt_phase_free_slots", "gauge",
+                 "free decode slots summed over one phase class "
+                 "(prefill replicas report 0)", _series("free_slots")),
+            ])
+        return fams
 
 
 # -- HTTP front-end ----------------------------------------------------------
@@ -664,12 +734,21 @@ class _RouterHandler(BaseHTTPRequestHandler):
         except ValueError as e:
             self._error(400, f"bad request: {e}")
             return
+        # disagg topology: /generate requests phase-split through the
+        # dispatcher (prefill pick → payload ship → pinned decode pick);
+        # /predict and everything else keep the monolithic path
+        disagg = getattr(self.server, "disagg", None)
         try:
             with obs_trace.span("http.route", cat="router",
                                 path=self.path, request_id=rid,
                                 slo=slo):
-                lease = router.dispatch(self.path, body, request_id=rid,
-                                        slo=slo)
+                if disagg is not None and self.path.startswith(
+                        "/generate"):
+                    lease = disagg.generate(self.path, body,
+                                            request_id=rid, slo=slo)
+                else:
+                    lease = router.dispatch(self.path, body,
+                                            request_id=rid, slo=slo)
         except NoReplicaError as e:
             self._error(503, str(e))
             return
@@ -790,14 +869,15 @@ class RouterServer(ThreadingHTTPServer):
 
     def __init__(self, addr, router: Router,
                  fleet: Optional["Fleet"] = None,
-                 autoscaler=None):
+                 autoscaler=None, disagg=None):
         super().__init__(addr, _RouterHandler)
         self.router = router
         # control-plane attachments (cli _serve_fleet wires these): the
         # fleet enables /admin/rollout; the autoscaler reports through
-        # /admin/fleet
+        # /admin/fleet; a DisaggDispatcher phase-splits /generate
         self.fleet = fleet
         self.autoscaler = autoscaler
+        self.disagg = disagg
 
     def admin_fleet(self) -> Dict[str, Any]:
         """GET /admin/fleet: one control-plane status document —
@@ -823,10 +903,10 @@ class RouterServer(ThreadingHTTPServer):
 
 def make_router_server(router: Router, host: str = "127.0.0.1",
                        port: int = 0, fleet: Optional["Fleet"] = None,
-                       autoscaler=None) -> RouterServer:
+                       autoscaler=None, disagg=None) -> RouterServer:
     """Bind (port 0 = OS-assigned; read `server.port`)."""
     return RouterServer((host, port), router, fleet=fleet,
-                        autoscaler=autoscaler)
+                        autoscaler=autoscaler, disagg=disagg)
 
 
 # -- replica processes + warm pool -------------------------------------------

@@ -326,6 +326,10 @@ class ContinuousScheduler:
         self.admitted_total = 0
         self.retired_total = 0
         self.tokens_total = 0
+        # disagg phase accounting: prefill-only requests served and
+        # wire-handoff requests admitted into the pool
+        self.prefills_total = 0
+        self.handoffs_admitted_total = 0
         self._occupancy_steps = 0  # sum of active-slot count over steps
         self._first_tok = self.metrics.histogram(
             "gen_first_token_seconds", buckets=FIRST_TOKEN_BUCKETS,
@@ -355,6 +359,15 @@ class ContinuousScheduler:
                  "breaker was open")
         # serving v3 surfaces (pre-registered even when the feature is
         # off, so the scrape surface never depends on configuration)
+        # disagg phase surfaces (serving/disagg): a monolithic replica
+        # scrapes these at 0, a phase replica moves exactly one of them
+        self.metrics.declare_counter(
+            "gen_prefill_total",
+            help="prefill-phase requests served (prefix program only, "
+                 "state shipped to a decode replica)")
+        self.metrics.declare_counter(
+            "gen_handoff_admitted_total",
+            help="wire-handoff requests admitted into the decode pool")
         self.metrics.declare_counter(
             "gen_prefix_hits_total",
             help="request rows admitted from the device-resident "
@@ -646,6 +659,112 @@ class ContinuousScheduler:
         budget = (timeout_ms / 1e3 if timeout_ms is not None
                   else self.timeout_s)
         return h.result(timeout=budget + max(1.0, budget))
+
+    # -- disagg phase split (serving/disagg) -----------------------------
+    def prefill(self, feed: Dict[str, np.ndarray],
+                request_id: Optional[str] = None) -> Tuple[tuple, tuple]:
+        """PREFILL phase of disaggregated serving: run ONLY the bucketed
+        prefix program and return the request's boot state as host
+        arrays sliced to the true row count — the payload of a
+        prefill→decode handoff. serving/disagg packs and ships it; the
+        decode replica admits it via submit_handoff through the same
+        pool_admit path a local prefix uses, so the phase split never
+        takes a different numeric path. No pool is touched: a
+        pure-prefill replica spends its HBM on big mesh-sharded prefix
+        batches, never on decode slots. The whole tuple crosses d2h in
+        ONE device_get fence (elastic.gather_handoff_rows), which is
+        also where mesh-sharded prefix outputs all-gather to host."""
+        from ..pipeline import elastic
+
+        if self.breaker is not None and not self.breaker.admit():
+            self.metrics.counter_inc(
+                "circuit_open_total",
+                help="requests rejected because the model's circuit "
+                     "breaker was open")
+            raise CircuitOpenError(
+                f"circuit open for model {self.engine.model_name!r}; "
+                "retry later")
+        rows = {v.shape[0] for v in feed.values()
+                if hasattr(v, "ndim") and v.ndim >= 1}
+        if len(rows) != 1:
+            raise ValueError(
+                f"generation feeds must share the batch axis; got row "
+                f"counts {sorted(rows)}")
+        n = rows.pop()
+        with obs_trace.span("gen.prefill", cat="gen",
+                            request_id=request_id, rows=n):
+            padded, _, _ = self.engine._pad_feed(
+                {k: np.asarray(v) for k, v in feed.items()})
+            jnp = self._jax.numpy
+            padded = {k: jnp.asarray(v) for k, v in padded.items()}
+            fn = self._build_prefix(padded)
+            boots, pes = fn(self._params, padded)
+            boots = elastic.gather_handoff_rows(boots, n)
+            pes = elastic.gather_handoff_rows(pes, n)
+        self.dispatches_total += 1
+        self.syncs_total += 1
+        self.prefills_total += 1
+        self.metrics.counter_inc(
+            "gen_prefill_total",
+            help="prefill-phase requests served (prefix program only, "
+                 "state shipped to a decode replica)")
+        return boots, pes
+
+    def submit_handoff(self, boots, pes,
+                       timeout_ms: Optional[float] = None,
+                       request_id: Optional[str] = None,
+                       slo: Optional[str] = None) -> GenHandle:
+        """DECODE phase of disaggregated serving: enqueue a request
+        whose prefix state arrived over the wire (host arrays [n, ...]
+        from a prefill replica's `prefill()`). State is placed onto this
+        replica's devices here — the restore half of the elastic handoff
+        — and then admitted into free slots by the worker through the
+        SAME jitted pool_admit dynamic-update a locally-prefixed request
+        uses: bit-identity with monolithic serving is structural.
+        Deadline/shed/breaker semantics match submit()."""
+        from ..pipeline import elastic
+
+        if self._draft is not None:
+            raise ValueError(
+                "disagg handoff does not carry draft-model state: serve "
+                "the decode class without --draft_model (speculative "
+                "decoding composes with monolithic serving only)")
+        if self.breaker is not None and not self.breaker.admit():
+            self.metrics.counter_inc(
+                "circuit_open_total",
+                help="requests rejected because the model's circuit "
+                     "breaker was open")
+            raise CircuitOpenError(
+                f"circuit open for model {self.engine.model_name!r}; "
+                "retry later")
+        boots, pes = tuple(boots), tuple(pes)
+        rows = {int(a.shape[0]) for a in boots + pes}
+        if len(rows) != 1:
+            raise ValueError(
+                f"handoff state arrays must share the row axis; got row "
+                f"counts {sorted(rows)}")
+        n = rows.pop()
+        deadline = time.monotonic() + (
+            timeout_ms / 1e3 if timeout_ms is not None else self.timeout_s)
+        req = _GenRequest(None, n, deadline, request_id=request_id,
+                          slo_class=slo or "interactive")
+        mesh = getattr(self.engine, "mesh", None)
+        req.boots = elastic.restore_handoff_rows(boots, mesh)
+        req.pes = elastic.restore_handoff_rows(pes, mesh)
+        with self._cond:
+            if self._stopping:
+                raise ShedError("scheduler stopped")
+        self._aq.put(req)  # sheds with ShedError/503 when full
+        if obs_trace._armed:
+            obs_trace.instant("gen.handoff_enqueue", cat="gen",
+                              request_id=req.request_id, rows=n)
+        self.handoffs_admitted_total += 1
+        self.metrics.counter_inc(
+            "gen_handoff_admitted_total",
+            help="wire-handoff requests admitted into the decode pool")
+        self.metrics.counter_inc(
+            "gen_requests_total", help="generation requests accepted")
+        return req.handle
 
     # -- pool construction ---------------------------------------------
     def _build_prefix(self, padded: Dict[str, Any], draft: bool = False):
@@ -1057,6 +1176,19 @@ class ContinuousScheduler:
                 return  # head-of-line request still owns the next slots
 
     def _run_prefix(self, req: _GenRequest) -> None:
+        if req.boots is not None:
+            # HANDOFF admission (serving/disagg): the prefix already ran
+            # on a prefill replica and this request carries device-placed
+            # boot state. Wire-schema fingerprints were validated at the
+            # /admit boundary; geometry is re-checked against the live
+            # pool here, then the rows flow through the UNCHANGED
+            # _admit_row → pool_admit path below.
+            mem_specs = tuple((tuple(b.shape[1:]), np.dtype(b.dtype))
+                              for b in req.boots)
+            pe_specs = tuple((tuple(p.shape[1:]), np.dtype(p.dtype))
+                             for p in req.pes)
+            self._ensure_pool(mem_specs, pe_specs)
+            return
         d = self._draft
         if self._pcache is not None:
             # device prefix-state cache probe: per-ROW raw-feed hash, so

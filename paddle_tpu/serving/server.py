@@ -186,6 +186,7 @@ class ModelRegistry:
         (or parse burden) of a full /metrics scrape."""
         now = time.monotonic()
         queue_depth = active = slots = dispatches = syncs = 0
+        prefills = handoffs = 0
         classes: Dict[str, int] = {}
         oldest: Optional[float] = None
         first_tok_p99 = 0.0
@@ -211,6 +212,8 @@ class ModelRegistry:
                 slots += s.max_slots
                 dispatches += s.dispatches_total
                 syncs += s.syncs_total
+                prefills += s.prefills_total
+                handoffs += s.handoffs_admitted_total
             queue_depth += m_depth
             for c, d in m_classes.items():
                 classes[c] = classes.get(c, 0) + d
@@ -230,7 +233,13 @@ class ModelRegistry:
                              if oldest is not None else 0.0),
             "active_slots": active,
             "max_slots": slots,
+            "free_slots": max(0, slots - active),
             "slot_occupancy": (active / slots) if slots else 0.0,
+            # disagg phase counters: which phase(s) this replica has
+            # actually served (a phase-classed replica shows exactly
+            # one of these moving; a monolithic replica neither)
+            "prefills_total": prefills,
+            "handoffs_admitted_total": handoffs,
             "first_token_p99_ms": round(first_tok_p99 * 1e3, 3),
             "dispatches_total": dispatches,
             "syncs_total": syncs,
@@ -300,6 +309,16 @@ class _Handler(BaseHTTPRequestHandler):
             self._error(404, f"no route {self.path!r}")
 
     def do_POST(self):
+        # disagg phase endpoints (serving/disagg): /prefill returns an
+        # opaque handoff payload, /admit takes one back — the admit
+        # body is raw bytes, not JSON, so neither can ride the
+        # predict/generate route loop below
+        if self.path == "/prefill" or self.path.startswith("/prefill/"):
+            self._prefill_route()
+            return
+        if self.path == "/admit" or self.path.startswith("/admit/"):
+            self._admit_route()
+            return
         for route, handler in (("/predict", self._predict),
                                ("/generate", self._generate)):
             if self.path == route:
@@ -420,6 +439,11 @@ class _Handler(BaseHTTPRequestHandler):
         except (ShedError, CircuitOpenError) as e:
             self._error(503, str(e))
             return
+        self._stream_handle(name, handle)
+
+    def _stream_handle(self, name, handle) -> None:
+        """Chunked-NDJSON relay of one GenHandle's event stream — the
+        shared tail of /generate and /admit streaming."""
         self.send_response(200)
         self.send_header("Content-Type", "application/x-ndjson")
         self.send_header("Transfer-Encoding", "chunked")
@@ -440,6 +464,141 @@ class _Handler(BaseHTTPRequestHandler):
                 self._write_chunk(b"")  # terminal zero-length chunk
         except (BrokenPipeError, ConnectionResetError):
             pass  # client went away; the scheduler finishes the slot
+
+    # -- disagg phase endpoints (serving/disagg) -------------------------
+    def _gen_target(self, route: str):
+        """Resolve a /prefill|/admit path to (name, engine, scheduler,
+        query options) or None after sending the error. Both endpoints
+        exist only for generation models."""
+        from urllib.parse import parse_qs, urlparse
+
+        u = urlparse(self.path)
+        name = "default"
+        if u.path.startswith(route + "/"):
+            name = u.path[len(route) + 1:] or "default"
+        reg = self.server.registry
+        try:
+            engine, _ = reg.get(name)
+        except KeyError:
+            self._error(404,
+                        f"unknown model {name!r}; have {reg.names()}")
+            return None
+        if engine.generation_spec() is None:
+            self._error(400, f"model {name!r} is not a generation model "
+                             f"(no beam_search_group op); {route} "
+                             "serves disagg generation only")
+            return None
+        try:
+            sched = engine.scheduler()
+        except ValueError as e:
+            self._error(400, str(e))
+            return None
+        opts = {k: v[-1] for k, v in parse_qs(u.query).items()}
+        return name, engine, sched, opts
+
+    def _prefill_route(self):
+        """POST /prefill[/<model>]: run ONLY the prefix phase and
+        return the request's decode boot state as an opaque handoff
+        payload (application/octet-stream) for a decode replica's
+        /admit. Body is the /generate body (+ optional
+        "handoff_quant": "int8")."""
+        from .disagg.handoff import (HandoffError, pack_handoff,
+                                     payload_schema)
+
+        got = self._gen_target("/prefill")
+        if got is None:
+            return
+        name, engine, sched, _ = got
+        rid = self._request_id("pf")
+        try:
+            length = int(self.headers.get("Content-Length", 0))
+            req = json.loads(self.rfile.read(length) or b"{}")
+            feed = engine.coerce_feed(req["inputs"])
+            quant = req.get("handoff_quant")
+        except (ValueError, KeyError, TypeError) as e:
+            self._error(400, f"bad request: {e}")
+            return
+        try:
+            with obs_trace.span("http.prefill", cat="http", model=name,
+                                request_id=rid):
+                boots, pes = sched.prefill(feed, request_id=rid)
+                payload = pack_handoff(
+                    boots, pes, payload_schema(engine.generation_meta),
+                    name, request_id=rid, quant=quant)
+        except (ShedError, CircuitOpenError) as e:
+            self._error(503, str(e))
+            return
+        except HandoffError as e:
+            self._error(400, str(e))
+            return
+        except Exception as e:
+            self._error(500, f"{type(e).__name__}: {e}")
+            return
+        self._send(200, payload,
+                   content_type="application/octet-stream",
+                   extra_headers=((REQUEST_ID_HEADER, rid),))
+
+    def _admit_route(self):
+        """POST /admit[/<model>]?stream=1&timeout_ms=N: admit a shipped
+        handoff payload into the decode pool. The body is the exact
+        bytes /prefill returned; request options ride the query string.
+        Schema-identity mismatch (mixed-version fleet) is a 409 — NOT
+        retryable on a same-version sibling, the fix is a rollout."""
+        from .disagg.handoff import (HandoffError, HandoffSchemaError,
+                                     unpack_handoff, validate_handoff)
+
+        got = self._gen_target("/admit")
+        if got is None:
+            return
+        name, engine, sched, opts = got
+        rid = self._request_id("adm")
+        length = int(self.headers.get("Content-Length", 0))
+        payload = self.rfile.read(length)
+        try:
+            with obs_trace.span("http.admit", cat="http", model=name,
+                                request_id=rid, bytes=len(payload)):
+                header, boots, pes = unpack_handoff(payload)
+                validate_handoff(header, engine.generation_meta)
+        except HandoffSchemaError as e:
+            self._error(409, str(e), kind="HandoffSchemaError")
+            return
+        except HandoffError as e:
+            self._error(400, str(e))
+            return
+        reg = self.server.registry
+        slo = resolve_class(reg.slo_policy.class_of(name),
+                            self.headers.get(SLO_HEADER))
+        timeout_ms = (int(opts["timeout_ms"])
+                      if "timeout_ms" in opts else None)
+        try:
+            handle = sched.submit_handoff(
+                boots, pes, timeout_ms=timeout_ms, request_id=rid,
+                slo=slo)
+        except (ShedError, CircuitOpenError) as e:
+            self._error(503, str(e))
+            return
+        except ValueError as e:
+            self._error(400, str(e))
+            return
+        if opts.get("stream") not in ("1", "true"):
+            budget = (timeout_ms / 1e3 if timeout_ms is not None
+                      else sched.timeout_s)
+            try:
+                outputs = handle.result(timeout=budget + max(1.0, budget))
+            except (ShedError, CircuitOpenError) as e:
+                self._error(503, str(e))
+                return
+            except DeadlineError as e:
+                self._error(504, str(e))
+                return
+            except Exception as e:
+                self._error(500, f"{type(e).__name__}: {e}")
+                return
+            self._send(200, {"model": name,
+                             "outputs": self._outputs_json(outputs)},
+                       extra_headers=((REQUEST_ID_HEADER, rid),))
+            return
+        self._stream_handle(name, handle)
 
     def _write_chunk(self, data: bytes) -> None:
         self.wfile.write(f"{len(data):x}\r\n".encode() + data + b"\r\n")
